@@ -1,0 +1,41 @@
+import numpy as np
+
+from wormhole_tpu.parallel.checkpoint import Checkpointer
+
+
+def _state(x):
+    return {"weights": np.full(5, x, np.float32), "iter": np.int64(x)}
+
+
+def test_fresh_load_returns_version_zero(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ver, state = ck.load(_state(0))
+    assert ver == 0
+    assert state["iter"] == 0
+
+
+def test_save_load_roundtrip(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1))
+    ck.save(2, _state(2))
+    ver, state = ck.load(_state(0))
+    assert ver == 2
+    np.testing.assert_array_equal(state["weights"], np.full(5, 2, np.float32))
+
+
+def test_gc_keeps_latest(tmp_path):
+    ck = Checkpointer(str(tmp_path), keep=2)
+    for v in range(1, 6):
+        ck.save(v, _state(v))
+    import os
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["ckpt_v4.msgpack", "ckpt_v5.msgpack"]
+
+
+def test_restart_semantics(tmp_path):
+    # kill/restart: a new Checkpointer over the same dir resumes
+    ck1 = Checkpointer(str(tmp_path))
+    ck1.save(3, _state(3))
+    ck2 = Checkpointer(str(tmp_path))
+    ver, state = ck2.load(_state(0))
+    assert ver == 3 and state["iter"] == 3

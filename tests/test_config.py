@@ -1,0 +1,43 @@
+import pytest
+
+from wormhole_tpu.utils.config import Algo, Config, Loss, load_config
+
+
+def test_defaults_match_reference_schema():
+    c = Config()
+    # defaults mirror proto/config.proto
+    assert c.data_format == "libsvm"
+    assert c.loss is Loss.LOGIT
+    assert c.algo is Algo.FTRL
+    assert c.minibatch == 1000
+    assert c.max_data_pass == 10
+    assert c.max_delay == 0
+    assert c.key_cache and c.msg_compression and c.fixed_bytes == 1
+
+
+def test_cli_overrides(tmp_path):
+    conf = tmp_path / "demo.conf"
+    conf.write_text(
+        "train_data = \"demo/train\"\n"
+        "algo = sgd\n"
+        "# comment\n"
+        "lambda = 1\n"
+        "lambda = 0.1\n"
+        "minibatch = 500\n")
+    c = load_config(str(conf), ["minibatch=900", "lr_eta=0.05", "algo=ftrl"])
+    assert c.train_data == "demo/train"
+    assert c.minibatch == 900        # CLI wins over file
+    assert c.algo is Algo.FTRL
+    assert c.lambda_ == [1.0, 0.1]   # repeated field accumulates
+    assert c.lr_eta == pytest.approx(0.05)
+
+
+def test_colon_style_and_bool():
+    c = load_config(None, ["key_cache=false", "loss:square_hinge"])
+    assert c.key_cache is False
+    assert c.loss is Loss.SQUARE_HINGE
+
+
+def test_unknown_key_raises():
+    with pytest.raises(ValueError):
+        load_config(None, ["no_such_key=1"])

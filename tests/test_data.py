@@ -1,0 +1,238 @@
+import io
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.data.feed import pad_to_batch
+from wormhole_tpu.data.input_split import InputSplit
+from wormhole_tpu.data.localizer import Localizer
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.parsers import (parse_adfea_chunk, parse_criteo_chunk,
+                                       parse_libsvm_chunk, _CRITEO_ITV)
+from wormhole_tpu.data.recordio import (RecordStream, RecordWriter,
+                                        decode_row, encode_row,
+                                        iter_record_blocks, MAGIC)
+from wormhole_tpu.data.rowblock import RowBlockContainer, concat_blocks
+
+
+# ---------------------------------------------------------------------------
+# parsers (reference: base/*parser.h golden behavior)
+# ---------------------------------------------------------------------------
+
+def test_libsvm_parse():
+    blk = parse_libsvm_chunk(b"1 0:1.5 3:2\n-1 2:0.5\n0 1:1\n")
+    assert blk.size == 3
+    assert blk.nnz == 4
+    np.testing.assert_array_equal(blk.offset, [0, 2, 3, 4])
+    np.testing.assert_array_equal(blk.label, [1, -1, 0])
+    np.testing.assert_array_equal(blk.index.astype(int), [0, 3, 2, 1])
+    np.testing.assert_allclose(blk.value, [1.5, 2, 0.5, 1])
+
+
+def test_libsvm_binary_features():
+    blk = parse_libsvm_chunk(b"1 5 7 9\n")
+    assert blk.value is None
+    np.testing.assert_array_equal(blk.index.astype(int), [5, 7, 9])
+
+
+def test_criteo_parse():
+    # label, 13 ints (some missing), 26 cats (some missing)
+    ints = ["4", "", "2"] + [""] * 10
+    cats = ["68fd1e64", ""] + [""] * 24
+    line = "\t".join(["1"] + ints + cats)
+    blk = parse_criteo_chunk(line.encode() + b"\n")
+    assert blk.size == 1
+    assert blk.label[0] == 1
+    # int feat slot i value v → v + i*itv; one categorical crc32
+    assert blk.nnz == 3
+    assert int(blk.index[0]) == 4
+    assert int(blk.index[1]) == (2 + 2 * _CRITEO_ITV) % 2 ** 64
+    assert blk.value is None
+
+
+def test_adfea_parse():
+    # lineid count label fea:gid fea:gid ; two rows
+    chunk = b"100 2 1 10:1 20:2 101 3 0 30:1\n"
+    blk = parse_adfea_chunk(chunk)
+    assert blk.size == 2
+    np.testing.assert_array_equal(blk.label, [1, 0])
+    np.testing.assert_array_equal(blk.offset, [0, 2, 3])
+    np.testing.assert_array_equal(blk.index.astype(int), [10, 20, 30])
+
+
+# ---------------------------------------------------------------------------
+# input split: every line read exactly once across parts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nparts", [1, 2, 3, 7])
+def test_input_split_partition(tmp_path, nparts):
+    lines = [f"{i} {i % 5}:1" for i in range(199)]
+    p = tmp_path / "x.txt"
+    p.write_text("\n".join(lines) + "\n")
+    seen = []
+    for k in range(nparts):
+        for chunk in InputSplit(str(p), k, nparts, chunk_bytes=64):
+            seen.extend(chunk.decode().split())
+    got = sorted(int(t) for t in seen if ":" not in t)
+    assert got == list(range(199))
+
+
+def test_input_split_multifile(tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.txt").write_text(
+            "\n".join(f"{i * 100 + j} 0:1" for j in range(50)) + "\n")
+    labels = []
+    for k in range(4):
+        sp = InputSplit(str(tmp_path / "f*.txt"), k, 4)
+        for chunk in sp:
+            labels += [int(l.split()[0]) for l in chunk.decode().splitlines()]
+    assert sorted(labels) == sorted(
+        [i * 100 + j for i in range(3) for j in range(50)])
+
+
+# ---------------------------------------------------------------------------
+# minibatch iterator: exact fixed-size slicing (minibatch_iter.h behavior)
+# ---------------------------------------------------------------------------
+
+def test_minibatch_iter_sizes(tmp_libsvm):
+    path, labels, _ = tmp_libsvm
+    it = MinibatchIter(path, 0, 1, "libsvm", minibatch_size=32)
+    sizes = [b.size for b in it]
+    assert sizes == [32, 32, 32, 4]
+    assert it.bytes_read() > 0
+    # second pass works (BeforeFirst semantics)
+    labels2 = np.concatenate([b.label for b in it])
+    np.testing.assert_array_equal(labels2, labels)
+
+
+# ---------------------------------------------------------------------------
+# recordio: roundtrip, magic-escaping, split ownership
+# ---------------------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    rows = [(1.0, np.array([1, 2, 3], np.uint64), None),
+            (0.0, np.array([7], np.uint64),
+             np.array([0.5], np.float32))]
+    p = tmp_path / "d.rec"
+    with open(p, "wb") as f:
+        w = RecordWriter(f)
+        for label, idx, val in rows:
+            w.write_row(label, idx, val)
+    got = [decode_row(r) for r in RecordStream(str(p))]
+    assert len(got) == 2
+    for (l0, i0, v0), (l1, i1, v1) in zip(rows, got):
+        assert l0 == l1
+        np.testing.assert_array_equal(i0, i1)
+        if v0 is None:
+            assert v1 is None
+        else:
+            np.testing.assert_array_equal(v0, v1)
+
+
+def test_recordio_magic_in_payload(tmp_path):
+    # craft a payload containing the aligned MAGIC word: must roundtrip
+    idx = np.array([MAGIC | (MAGIC << 32)] * 7, np.uint64)
+    p = tmp_path / "m.rec"
+    with open(p, "wb") as f:
+        RecordWriter(f).write_row(1.0, idx, None)
+    (got,) = [decode_row(r) for r in RecordStream(str(p))]
+    np.testing.assert_array_equal(got[1], idx)
+
+
+def test_recordio_aligned_magic_splits_and_resyncs(tmp_path):
+    # payloads with MAGIC at 4-aligned offsets (incl. offset 0 and
+    # consecutive magics) force the continuation-split path; they must
+    # roundtrip AND part-k/n reads must still see every record exactly once
+    import struct
+    m = struct.pack("<I", MAGIC)
+    payloads = [m + b"abcd" + m + m + b"tail",      # magic at 0, 8, 12
+                b"abcd" + m + b"efgh",              # magic at 4
+                b"plain-no-magic!!",                # control
+                m * 5]                              # all magic
+    p = tmp_path / "esc.rec"
+    with open(p, "wb") as f:
+        w = RecordWriter(f)
+        for i in range(40):
+            w.write_record(payloads[i % 4])
+    whole = list(RecordStream(str(p)))
+    assert whole == [payloads[i % 4] for i in range(40)]
+    for nparts in (2, 3, 7):
+        seen = []
+        for k in range(nparts):
+            seen.extend(RecordStream(str(p), k, nparts))
+        assert sorted(seen) == sorted(whole), nparts
+
+
+@pytest.mark.parametrize("nparts", [1, 2, 3, 5])
+def test_recordio_split_exactly_once(tmp_path, nparts, rng):
+    p = tmp_path / "s.rec"
+    n = 100
+    with open(p, "wb") as f:
+        w = RecordWriter(f)
+        for i in range(n):
+            nnz = rng.integers(1, 20)
+            w.write_row(float(i), rng.integers(0, 1 << 40, nnz).astype(np.uint64))
+    seen = []
+    for k in range(nparts):
+        for payload in RecordStream(str(p), k, nparts):
+            seen.append(int(decode_row(payload)[0]))
+    assert sorted(seen) == list(range(n))
+
+
+def test_record_blocks(tmp_path):
+    p = tmp_path / "b.rec"
+    with open(p, "wb") as f:
+        w = RecordWriter(f)
+        for i in range(10):
+            w.write_row(float(i % 2), np.array([i, i + 1], np.uint64))
+    blocks = list(iter_record_blocks(RecordStream(str(p)), rows_per_block=4))
+    assert [b.size for b in blocks] == [4, 4, 2]
+    assert blocks[0].nnz == 8
+
+
+# ---------------------------------------------------------------------------
+# localizer (reference localizer_test.cc golden)
+# ---------------------------------------------------------------------------
+
+def test_localizer_remap():
+    c = RowBlockContainer()
+    c.push(1.0, np.array([100, 5, 100], np.uint64))
+    c.push(0.0, np.array([7, 5], np.uint64))
+    loc = Localizer().localize(c.finalize())
+    np.testing.assert_array_equal(loc.uniq_keys.astype(int), [5, 7, 100])
+    np.testing.assert_array_equal(loc.block.index, [2, 0, 2, 1, 0])
+    np.testing.assert_array_equal(loc.freq, [2, 1, 2])
+
+
+def test_localizer_fold_and_tail():
+    c = RowBlockContainer()
+    c.push(1.0, np.array([1, 2, 3, 2], np.uint64))
+    c.push(0.0, np.array([2, 9], np.uint64))
+    loc = Localizer(tail_freq=1).localize(c.finalize())
+    # only key 2 (freq 3) survives tail_freq=1... freq>1 keeps 2 only
+    assert list(loc.uniq_keys.astype(int)) == [2]
+    assert loc.block.nnz == 3
+    np.testing.assert_array_equal(loc.block.offset, [0, 2, 3])
+    folded = Localizer(num_buckets=8).localize(c.finalize())
+    assert folded.uniq_keys.max() < 8
+
+
+# ---------------------------------------------------------------------------
+# device feed: padded batch reproduces the scipy matmul
+# ---------------------------------------------------------------------------
+
+def test_pad_to_batch_matches_scipy(tmp_libsvm):
+    path, labels, X = tmp_libsvm
+    it = MinibatchIter(path, 0, 1, "libsvm", minibatch_size=64)
+    blocks = list(it)
+    w = np.random.default_rng(1).normal(size=X.shape[1]).astype(np.float32)
+    done = 0
+    for blk in blocks:
+        loc = Localizer().localize(blk)
+        sb = pad_to_batch(loc, 64, max_nnz=32)
+        w_local = w[loc.uniq_keys.astype(int)]
+        xw = (sb.vals * w_local[np.asarray(sb.cols)]).sum(-1)
+        expect = X[done:done + blk.size] @ w
+        np.testing.assert_allclose(xw[:blk.size], expect, rtol=1e-4, atol=1e-5)
+        assert np.all(xw[blk.size:] == 0)
+        done += blk.size

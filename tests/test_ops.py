@@ -1,0 +1,96 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wormhole_tpu.ops.loss import create_loss
+from wormhole_tpu.ops.metrics import accuracy, auc, logloss
+from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.ops.spmv import spmv_times, spmv_trans_times
+
+
+def _rand_batch(rng, mb=16, nnz=8, k=40):
+    cols = rng.integers(0, k, (mb, nnz)).astype(np.int32)
+    vals = rng.normal(size=(mb, nnz)).astype(np.float32)
+    vals[rng.random((mb, nnz)) < 0.3] = 0  # padding-like zeros
+    return cols, vals
+
+
+def test_spmv_matches_dense(rng):
+    # reference spmv_test.cc: multi-thread vs 1-thread; here device vs numpy
+    cols, vals = _rand_batch(rng)
+    w = rng.normal(size=40).astype(np.float32)
+    got = np.asarray(spmv_times(jnp.asarray(cols), jnp.asarray(vals),
+                                jnp.asarray(w)))
+    expect = np.einsum("bn,bn->b", vals, w[cols])
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_spmv_trans_matches_scatter(rng):
+    cols, vals = _rand_batch(rng)
+    dual = rng.normal(size=16).astype(np.float32)
+    got = np.asarray(spmv_trans_times(jnp.asarray(cols), jnp.asarray(vals),
+                                      jnp.asarray(dual), 40))
+    expect = np.zeros(40, np.float32)
+    for b in range(16):
+        for j in range(8):
+            expect[cols[b, j]] += vals[b, j] * dual[b]
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_spmv_adjoint(rng):
+    # <Xw, d> == <w, X^T d>
+    cols, vals = _rand_batch(rng)
+    w = rng.normal(size=40).astype(np.float32)
+    d = rng.normal(size=16).astype(np.float32)
+    lhs = float(spmv_times(cols, vals, w) @ d)
+    rhs = float(w @ spmv_trans_times(cols, vals, d, 40))
+    assert lhs == pytest.approx(rhs, rel=1e-4)
+
+
+def test_l1l2_prox_golden():
+    # penalty.h:36-41 semantics
+    p = L1L2(lambda1=1.0, lambda2=0.5)
+    z = jnp.asarray([3.0, -3.0, 0.5, -0.5, 0.0])
+    eta = jnp.asarray(1.5)
+    got = np.asarray(p.solve(z, eta))
+    np.testing.assert_allclose(got, [2.0 / 2.0, -2.0 / 2.0, 0, 0, 0])
+
+
+@pytest.mark.parametrize("name", ["logit", "square_hinge", "square"])
+def test_loss_dual_is_gradient(name, rng):
+    # dual == d objv / d margin, verified by autodiff
+    objv_fn, dual_fn = create_loss(name)
+    m = jnp.asarray(rng.normal(size=12).astype(np.float32))
+    y = jnp.asarray((rng.random(12) < 0.5).astype(np.float32))
+    mask = jnp.asarray((rng.random(12) < 0.8).astype(np.float32))
+    auto = jax.grad(lambda mm: objv_fn(mm, y, mask))(m)
+    np.testing.assert_allclose(np.asarray(dual_fn(m, y, mask)),
+                               np.asarray(auto), rtol=1e-4, atol=1e-5)
+
+
+def test_auc_golden():
+    # hand case: perfect ranking -> 1.0; inverted -> 0.0
+    y = jnp.asarray([1.0, 1, 0, 0])
+    mask = jnp.ones(4)
+    assert float(auc(y, jnp.asarray([4.0, 3, 2, 1]), mask)) == pytest.approx(1.0)
+    assert float(auc(y, jnp.asarray([1.0, 2, 3, 4]), mask)) == pytest.approx(0.0)
+    # half right
+    assert float(auc(y, jnp.asarray([4.0, 1, 3, 2]), mask)) == pytest.approx(0.5)
+
+
+def test_auc_masked_rows_ignored():
+    y = jnp.asarray([1.0, 1, 0, 0, 0, 1])
+    m = jnp.asarray([4.0, 3, 2, 1, 99, -99])
+    mask = jnp.asarray([1.0, 1, 1, 1, 0, 0])
+    assert float(auc(y, m, mask)) == pytest.approx(1.0)
+
+
+def test_accuracy_and_logloss():
+    y = jnp.asarray([1.0, 0, 1, 0])
+    m = jnp.asarray([2.0, -2, -1, 1])
+    mask = jnp.ones(4)
+    assert float(accuracy(y, m, mask)) == pytest.approx(0.5)
+    # logloss of a confident-correct pair is small, wrong pair large
+    ll = float(logloss(y, m, mask))
+    assert 0.5 < ll < 1.5

@@ -1,0 +1,20 @@
+"""wormhole-tpu: a TPU-native distributed ML framework.
+
+A ground-up JAX/XLA/pjit/pallas rebuild of the capabilities of DMLC wormhole
+(reference: SiNZeRo/wormhole): streaming sparse-data pipelines, a
+sharded-parameter online learner (async SGD / AdaGrad / FTRL with bounded
+staleness), distributed vector-free L-BFGS (OWL-QN), BSP k-means, and a
+histogram-allreduce GBDT.
+
+Layer map (mirrors reference SURVEY.md §1, rebuilt TPU-first):
+
+  L6  launch        wormhole_tpu.parallel.launcher   (ref: dmlc-core/tracker)
+  L5  apps          wormhole_tpu.models              (ref: learn/*)
+  L4  solvers       wormhole_tpu.solver, .learners   (ref: learn/solver, sgd/*)
+  L3  scheduling    wormhole_tpu.sched               (ref: base/workload_pool.h)
+  L2  collectives   wormhole_tpu.parallel            (ref: rabit, ps-lite)
+  L1  data plane    wormhole_tpu.data                (ref: base/*parser*, dmlc-core IO)
+  L0  kernels       wormhole_tpu.ops                 (ref: base/spmv.h etc.)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+from wormhole_tpu.data.rowblock import RowBlock, RowBlockContainer
+from wormhole_tpu.data.stream import open_stream
+from wormhole_tpu.data.input_split import InputSplit
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.localizer import Localizer
+from wormhole_tpu.data.feed import SparseBatch, pad_to_batch

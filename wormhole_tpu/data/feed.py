@@ -1,0 +1,115 @@
+"""Device feed: pad ragged CSR minibatches into fixed-shape dense arrays.
+
+This is the TPU-specific piece with no direct reference analogue (SURVEY.md
+§7 stage 1): XLA compiles per shape, so sparse minibatches are padded/bucketed
+into a small set of static shapes — ``(mb, max_nnz)`` index/value arrays plus
+masks — and the per-batch unique-key vector (from the Localizer) is padded to
+a bucketed length. Padding entries point at local id 0 with value 0, so every
+op (gather, segment-sum scatter) treats them as no-ops; padded keys carry a
+zero mask so their parameter updates vanish.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from wormhole_tpu.data.localizer import Localized
+from wormhole_tpu.data.rowblock import RowBlock
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SparseBatch:
+    """Fixed-shape padded sparse minibatch (a pytree of arrays).
+
+    cols[i, j] is the *local* feature id of the j-th entry of row i (0 when
+    padded — harmless because vals is 0 there); uniq_keys maps local ids back
+    to global bucket ids for parameter pull/push.
+    """
+
+    cols: jax.Array       # int32 (mb, max_nnz)
+    vals: jax.Array       # f32   (mb, max_nnz); 0 on padding
+    labels: jax.Array     # f32   (mb,)
+    row_mask: jax.Array   # f32   (mb,); 1 real row, 0 padded row
+    uniq_keys: jax.Array  # int64/int32 (kpad,); global bucket id per local id
+    key_mask: jax.Array   # f32   (kpad,); 1 real key, 0 padding
+
+    @property
+    def batch_size(self) -> int:
+        return self.cols.shape[0]
+
+    @property
+    def num_local_keys(self) -> int:
+        return self.uniq_keys.shape[0]
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.row_mask).sum())
+
+
+def next_bucket(n: int, minimum: int = 256) -> int:
+    """Round up to a power of two (shape-bucketing to bound recompiles)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to_batch(loc: Localized, minibatch_size: int,
+                 max_nnz: int, key_pad: Optional[int] = None,
+                 key_dtype=np.int32) -> SparseBatch:
+    """Pad a localized RowBlock into a SparseBatch.
+
+    Rows with more than ``max_nnz`` entries are truncated positionally (the
+    first ``max_nnz`` entries in storage order are kept).
+
+    ``uniq_keys`` must fit ``key_dtype``: use Localizer bucket folding (or an
+    explicitly 64-bit dtype) for raw 64-bit id spaces — a silent wraparound
+    would corrupt parameter pull/push, so it raises instead."""
+    blk = loc.block
+    mb = minibatch_size
+    n = blk.size
+    assert n <= mb, (n, mb)
+
+    cols = np.zeros((mb, max_nnz), np.int32)
+    vals = np.zeros((mb, max_nnz), np.float32)
+    per_row = np.diff(blk.offset).astype(np.int64)
+    values = blk.values_or_ones()
+
+    if blk.nnz:
+        row_ids = np.repeat(np.arange(n, dtype=np.int64), per_row)
+        pos = np.arange(blk.nnz, dtype=np.int64) - np.repeat(
+            blk.offset[:-1].astype(np.int64), per_row)
+        keep = pos < max_nnz  # rows beyond max_nnz are truncated
+        cols[row_ids[keep], pos[keep]] = blk.index[keep]
+        vals[row_ids[keep], pos[keep]] = values[keep]
+
+    labels = np.zeros(mb, np.float32)
+    labels[:n] = blk.label
+    row_mask = np.zeros(mb, np.float32)
+    row_mask[:n] = 1.0
+    if blk.weight is not None:
+        row_mask[:n] = blk.weight
+
+    k = len(loc.uniq_keys)
+    kpad = key_pad or next_bucket(k)
+    assert k <= kpad, (k, kpad)
+    if k and int(loc.uniq_keys.max()) > np.iinfo(key_dtype).max:
+        raise OverflowError(
+            f"uniq key {int(loc.uniq_keys.max())} exceeds {np.dtype(key_dtype)}; "
+            "fold the key space with Localizer(num_buckets=...) or pass "
+            "key_dtype=np.int64")
+    uniq = np.zeros(kpad, key_dtype)
+    uniq[:k] = loc.uniq_keys.astype(key_dtype)
+    key_mask = np.zeros(kpad, np.float32)
+    key_mask[:k] = 1.0
+
+    return SparseBatch(cols=cols, vals=vals, labels=labels, row_mask=row_mask,
+                       uniq_keys=uniq, key_mask=key_mask)
+
+
+def batch_max_nnz(blk: RowBlock, cap: int = 4096) -> int:
+    return min(next_bucket(max(blk.max_row_nnz(), 1), 8), cap)

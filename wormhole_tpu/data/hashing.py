@@ -1,0 +1,60 @@
+"""Feature-id hashing.
+
+The reference hashes criteo categorical strings with hardware CRC32
+(``learn/linear/base/crc32.h:29-55``), 64-bit ids with CityHash
+(``learn/linear/tool/text2rec.cc:59``), and folds the id space with the
+``max_key`` hash kernel (``learn/linear/base/localizer.h:88-96``). The rebuild
+keeps the same three capabilities — a 32-bit string hash, a 64-bit string
+hash, and a key-space fold — with well-defined portable functions (zlib crc32
+and a splitmix64-style mixer); exact hash values are an implementation detail
+the reference also leaves unspecified across builds (SSE4.2 vs CityHash).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+_U64 = np.uint64
+
+
+def crc32_hash(data: bytes) -> int:
+    """32-bit string hash for categorical features (crc32.h analogue)."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def hash64(data: bytes) -> int:
+    """64-bit string hash (CityHash64 analogue): crc32 of both halves mixed."""
+    h = (zlib.crc32(data) & 0xFFFFFFFF) | ((zlib.crc32(data[::-1]) & 0xFFFFFFFF) << 32)
+    return splitmix64(h)
+
+
+def splitmix64(x: int) -> int:
+    """Finalizing 64-bit mixer (public splitmix64 constants)."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64 array."""
+    x = x.astype(_U64, copy=True)
+    with np.errstate(over="ignore"):
+        x += _U64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> _U64(27))) * _U64(0x94D049BB133111EB)
+        return x ^ (x >> _U64(31))
+
+
+def fold_keys(keys: np.ndarray, num_buckets: int, hashed: bool = True) -> np.ndarray:
+    """Fold a 64-bit key space into [0, num_buckets) bucket ids.
+
+    The reference folds with ``key % FLAGS_max_key`` after an optional hash
+    (``localizer.h:88-96``); collisions are accepted. ``hashed=True`` mixes
+    first so adjacent raw ids spread across buckets (and across mesh shards)."""
+    k = keys.astype(_U64, copy=False)
+    if hashed:
+        k = splitmix64_np(k)
+    return (k % _U64(num_buckets)).astype(np.int64)

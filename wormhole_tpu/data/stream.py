@@ -1,0 +1,133 @@
+"""URI-addressed byte streams: ``file://``-style local paths, ``s3://``,
+``hdfs://``.
+
+Rebuild of dmlc-core ``Stream::Create`` and ``io::FileSystem`` (consumed by
+the reference at ``learn/linear/base/arg_parser.h:19``,
+``learn/linear/base/workload_pool.h:46-49``). Local paths are first-class;
+S3/HDFS are pluggable via `register_filesystem` and ship as informative stubs
+(this image has no egress / no boto3), so the URI surface and part-k/n
+semantics stay identical across backends.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io
+import os
+import re
+from typing import Callable, Dict, List, Tuple
+
+
+class FileInfo:
+    __slots__ = ("path", "size")
+
+    def __init__(self, path: str, size: int) -> None:
+        self.path = path
+        self.size = size
+
+    def __repr__(self) -> str:
+        return f"FileInfo({self.path!r}, {self.size})"
+
+
+class FileSystem:
+    """Minimal FS interface: open(uri, mode) + list_directory(uri)."""
+
+    def open(self, uri: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def list_directory(self, uri: str) -> List[FileInfo]:
+        raise NotImplementedError
+
+    def size(self, uri: str) -> int:
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def open(self, uri: str, mode: str = "rb"):
+        path = _strip_scheme(uri)
+        if "w" in mode or "a" in mode:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+        return open(path, mode)
+
+    def list_directory(self, uri: str) -> List[FileInfo]:
+        path = _strip_scheme(uri)
+        if os.path.isdir(path):
+            names = [os.path.join(path, n) for n in sorted(os.listdir(path))]
+        else:
+            names = sorted(_glob.glob(path))
+        return [FileInfo(n, os.path.getsize(n)) for n in names if os.path.isfile(n)]
+
+    def size(self, uri: str) -> int:
+        return os.path.getsize(_strip_scheme(uri))
+
+
+class _StubFileSystem(FileSystem):
+    def __init__(self, scheme: str, hint: str) -> None:
+        self._scheme, self._hint = scheme, hint
+
+    def open(self, uri: str, mode: str = "rb"):
+        raise NotImplementedError(
+            f"{self._scheme}:// filesystem backend not available: {self._hint}")
+
+    list_directory = open  # type: ignore[assignment]
+    size = open  # type: ignore[assignment]
+
+
+_REGISTRY: Dict[str, FileSystem] = {
+    "": LocalFileSystem(),
+    "file": LocalFileSystem(),
+    "s3": _StubFileSystem("s3", "register one via register_filesystem('s3', fs) "
+                          "backed by boto3/s3fs"),
+    "hdfs": _StubFileSystem("hdfs", "register one via register_filesystem('hdfs', fs) "
+                            "backed by pyarrow.fs.HadoopFileSystem"),
+}
+
+
+def register_filesystem(scheme: str, fs: FileSystem) -> None:
+    _REGISTRY[scheme] = fs
+
+
+def _split_scheme(uri: str) -> Tuple[str, str]:
+    m = re.match(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://", uri)
+    return (m.group(1), uri) if m else ("", uri)
+
+
+def _strip_scheme(uri: str) -> str:
+    scheme, _ = _split_scheme(uri)
+    return uri[len(scheme) + 3:] if scheme == "file" else uri
+
+
+def get_filesystem(uri: str) -> FileSystem:
+    scheme, _ = _split_scheme(uri)
+    try:
+        return _REGISTRY[scheme]
+    except KeyError:
+        raise ValueError(f"no filesystem registered for scheme {scheme!r}") from None
+
+
+def open_stream(uri: str, mode: str = "rb"):
+    """dmlc ``Stream::Create`` equivalent."""
+    return get_filesystem(uri).open(uri, mode)
+
+
+def list_files(pattern: str) -> List[FileInfo]:
+    """List files matching a path/glob/regex on any registered FS.
+
+    Mirrors the reference WorkloadPool's ListDirectory + regex match
+    (``workload_pool.h:46-66``): the final path component is treated as a
+    regex if the plain listing finds nothing."""
+    fs = get_filesystem(pattern)
+    found = fs.list_directory(pattern)
+    if found:
+        return found
+    head, _, tail = pattern.rpartition("/")
+    if head and tail:
+        try:
+            rx = re.compile(tail)
+        except re.error:
+            return []
+        return [fi for fi in fs.list_directory(head)
+                if rx.search(os.path.basename(fi.path))]
+    return []

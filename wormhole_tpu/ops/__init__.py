@@ -1,0 +1,3 @@
+from wormhole_tpu.ops.spmv import spmv_times, spmv_trans_times
+from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.ops import metrics, loss

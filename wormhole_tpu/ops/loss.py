@@ -1,0 +1,73 @@
+"""Scalar losses over margins — objective values and dual gradients.
+
+Rebuild of the reference loss library (``learn/linear/base/loss.h``:
+``ScalarLoss`` caches Xw on Init, ``LogitLoss``/``SquareHingeLoss`` implement
+Objv and CalcGrad where grad = Xᵀ·dual). Labels arrive as 0/1 floats and are
+mapped to y ∈ {-1, +1} as in the reference. All functions take a row mask
+(padded rows contribute 0) and return sums, not means — merging across
+workers/shards is then a plain add/psum, matching the Progress merge
+semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _to_pm1(labels: jax.Array) -> jax.Array:
+    return 2.0 * (labels > 0.5) - 1.0
+
+
+def logit_objv(margin: jax.Array, labels: jax.Array,
+               mask: jax.Array) -> jax.Array:
+    """Σ log(1 + exp(-y·m)) over real rows (stable via softplus)."""
+    ym = _to_pm1(labels) * margin
+    return jnp.sum(jax.nn.softplus(-ym) * mask)
+
+
+def logit_dual(margin: jax.Array, labels: jax.Array,
+               mask: jax.Array) -> jax.Array:
+    """d objv / d margin = -y·σ(-y·m), masked."""
+    y = _to_pm1(labels)
+    return -y * jax.nn.sigmoid(-y * margin) * mask
+
+
+def square_hinge_objv(margin: jax.Array, labels: jax.Array,
+                      mask: jax.Array) -> jax.Array:
+    """Σ max(0, 1 - y·m)² over real rows."""
+    t = jnp.maximum(0.0, 1.0 - _to_pm1(labels) * margin)
+    return jnp.sum(t * t * mask)
+
+
+def square_hinge_dual(margin: jax.Array, labels: jax.Array,
+                      mask: jax.Array) -> jax.Array:
+    y = _to_pm1(labels)
+    t = jnp.maximum(0.0, 1.0 - y * margin)
+    return -2.0 * y * t * mask
+
+
+def square_objv(margin: jax.Array, labels: jax.Array,
+                mask: jax.Array) -> jax.Array:
+    d = margin - labels
+    return 0.5 * jnp.sum(d * d * mask)
+
+
+def square_dual(margin: jax.Array, labels: jax.Array,
+                mask: jax.Array) -> jax.Array:
+    return (margin - labels) * mask
+
+
+_LOSSES = {
+    "logit": (logit_objv, logit_dual),
+    "square_hinge": (square_hinge_objv, square_hinge_dual),
+    "square": (square_objv, square_dual),
+}
+
+
+def create_loss(name: str):
+    """Factory (reference CreateLoss, loss.h:130-141): (objv_fn, dual_fn)."""
+    key = name.lower() if isinstance(name, str) else name.value
+    if key not in _LOSSES:
+        raise ValueError(f"unknown loss {name!r}; have {sorted(_LOSSES)}")
+    return _LOSSES[key]

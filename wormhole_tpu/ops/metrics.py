@@ -1,0 +1,54 @@
+"""Evaluation metrics: sort-based AUC, thresholded accuracy, logloss.
+
+Rebuild of ``learn/linear/base/evaluation.h:38-88``. Computed with jnp sorts
+and reductions so they run on-device and merge across the mesh by summing
+(numerator, denominator) pairs. All take a row mask for padded rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def auc(labels: jax.Array, margin: jax.Array, mask: jax.Array) -> jax.Array:
+    """Area under the ROC curve via the rank-sum formulation.
+
+    Masked rows get a margin of -inf and weight 0 so they never contribute.
+    Returns 0.5 when either class is empty (matching the reference's
+    degenerate behavior of an undefined AUC)."""
+    pos = (labels > 0.5).astype(jnp.float32) * mask
+    neg = mask - pos
+    # ranks of each row by margin, average-free (ties broken by sort order,
+    # same as the reference's sort-based computation)
+    order = jnp.argsort(jnp.where(mask > 0, margin, -jnp.inf))
+    ranks = jnp.zeros_like(margin).at[order].set(
+        jnp.arange(1, margin.shape[0] + 1, dtype=jnp.float32))
+    npos = jnp.sum(pos)
+    nneg = jnp.sum(neg)
+    rank_sum = jnp.sum(ranks * pos)
+    # subtract ranks occupied by masked rows (they sort to the bottom, so
+    # real rows' ranks are already offset correctly only when masked rows
+    # rank lowest — which -inf guarantees... except they then occupy the
+    # lowest ranks; compensate by the count of masked rows below everything)
+    num_masked = margin.shape[0] - jnp.sum(mask)
+    rank_sum = rank_sum - num_masked * npos
+    a = (rank_sum - npos * (npos + 1) / 2) / jnp.maximum(npos * nneg, 1.0)
+    return jnp.where((npos > 0) & (nneg > 0), a, 0.5)
+
+
+def accuracy(labels: jax.Array, margin: jax.Array, mask: jax.Array,
+             threshold: float = 0.0) -> jax.Array:
+    """Fraction of rows where sign(margin - threshold) matches the label."""
+    pred = (margin > threshold).astype(jnp.float32)
+    truth = (labels > 0.5).astype(jnp.float32)
+    correct = jnp.sum((pred == truth) * mask)
+    return correct / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def logloss(labels: jax.Array, margin: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean negative log-likelihood of the logistic model."""
+    y = (labels > 0.5).astype(jnp.float32)
+    # -[y log p + (1-y) log(1-p)] with p = σ(margin), stable form
+    ll = jax.nn.softplus(margin) - y * margin
+    return jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,35 @@
+"""Proximal penalty operators.
+
+Rebuild of the reference ``L1L2`` soft-thresholding prox
+(``learn/linear/base/penalty.h:36-41``): with ``z = eta·w − grad`` (the
+proximal-gradient step scaled by the curvature estimate ``eta``),
+
+    solve(z, eta) = shrink(z, λ1) / (eta + λ2)
+
+i.e. 0 inside the λ1 band, shifted toward 0 by λ1 outside, scaled by the
+L2-damped curvature. Callers that accumulate ``z`` with the opposite sign
+(FTRL's z) pass ``-z``, exactly as the reference handles do
+(``sgd_server_handle.h:135``). Pure elementwise function — vmaps/shards
+trivially.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class L1L2:
+    lambda1: float = 0.0
+    lambda2: float = 0.0
+
+    def solve(self, z: jax.Array, eta: jax.Array) -> jax.Array:
+        shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - self.lambda1, 0.0)
+        return shrunk / (eta + self.lambda2)
+
+    def cost(self, w: jax.Array) -> jax.Array:
+        return (self.lambda1 * jnp.sum(jnp.abs(w))
+                + 0.5 * self.lambda2 * jnp.sum(w * w))

@@ -1,0 +1,39 @@
+"""Sparse matrix-vector products on padded batches — the L0 compute kernel.
+
+Rebuild of the reference SpMV (``learn/linear/base/spmv.h:10-121``: OMP
+row-partitioned ``y = D x`` and column-partitioned ``y = Dᵀ x``) for the TPU
+compute model: the CSR block arrives as fixed-shape ``(mb, max_nnz)``
+gather-index/value arrays (see data/feed.py), so
+
+- ``Times``  (y = X w)  = gather ``w`` at ``cols`` + masked row reduction, and
+- ``TransTimes`` (y = Xᵀ d) = scatter-add of ``d·vals`` into the key axis,
+
+both of which XLA fuses into a handful of HBM-bandwidth-bound passes; no
+scalar loops, no dynamic shapes. The OMP thread partitioning disappears —
+the VPU lanes and the mesh sharding of the key axis take its place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def spmv_times(cols: jax.Array, vals: jax.Array, w: jax.Array) -> jax.Array:
+    """y = X w.  cols/vals: (mb, max_nnz); w: (k,) → y: (mb,).
+
+    Padding entries have vals == 0 so they contribute nothing."""
+    return jnp.einsum("bn,bn->b", vals, w[cols])
+
+
+def spmv_trans_times(cols: jax.Array, vals: jax.Array, dual: jax.Array,
+                     num_keys: int) -> jax.Array:
+    """y = Xᵀ d.  dual: (mb,) → y: (num_keys,), scatter-add over local ids."""
+    contrib = vals * dual[:, None]  # (mb, max_nnz)
+    return jnp.zeros(num_keys, vals.dtype).at[cols.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop")
+
+
+def row_nnz(vals: jax.Array) -> jax.Array:
+    """Number of real entries per row (padding is exactly 0)."""
+    return jnp.sum(vals != 0, axis=-1)

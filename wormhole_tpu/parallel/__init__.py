@@ -1,0 +1,4 @@
+from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+from wormhole_tpu.parallel.collectives import (allreduce_tree, broadcast_tree,
+                                               psum_tree)
+from wormhole_tpu.parallel.checkpoint import Checkpointer

@@ -1,0 +1,99 @@
+"""Collectives: the rabit Allreduce/Broadcast surface, TPU-native.
+
+The reference consumes rabit through 8 calls (SURVEY.md §2.2). Their TPU
+equivalents split by where they run:
+
+- **inside jit** (the hot path): ``psum/pmax/pmin`` over mesh axis names —
+  use ``psum_tree`` etc. from inside ``shard_map``/pjit-compiled steps. XLA
+  lowers these onto ICI rings; nothing to implement.
+- **host level** (setup, metrics, model broadcast): thin wrappers that jit a
+  collective over the live mesh. On one host with one mesh these reduce over
+  the *device* axis; across hosts JAX's multi-controller runtime makes the
+  same program global (each process provides its addressable shards).
+
+Lazy-prepare Allreduce (rabit's fault-tolerance hook, kmeans.cc:249) maps to
+calling ``prepare_fn`` only when no cached reduce result exists — see
+``CachedAllreduce``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# ---------------------------------------------------------------------------
+# in-jit collectives (use inside shard_map'ed/pjit'ed code)
+# ---------------------------------------------------------------------------
+
+def psum_tree(tree: Any, axis: str) -> Any:
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis), tree)
+
+def pmax_tree(tree: Any, axis: str) -> Any:
+    return jax.tree.map(lambda x: jax.lax.pmax(x, axis), tree)
+
+def pmin_tree(tree: Any, axis: str) -> Any:
+    return jax.tree.map(lambda x: jax.lax.pmin(x, axis), tree)
+
+
+# ---------------------------------------------------------------------------
+# host-level collectives over a mesh
+# ---------------------------------------------------------------------------
+
+def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum") -> Any:
+    """Sum/max/min-allreduce a host-local pytree across the data-parallel
+    world (rabit::Allreduce analogue).
+
+    Each process contributes its local values; result is replicated. On a
+    single process this is the identity for 'sum' *per device contribution*
+    semantics: the caller holds one logical copy, so no scaling happens."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+    fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+
+    def reduce_leaf(x):
+        gathered = multihost_utils.process_allgather(jnp.asarray(x))
+        return np.asarray(fn(gathered, axis=0))
+
+    return jax.tree.map(reduce_leaf, tree)
+
+
+def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0) -> Any:
+    """rabit::Broadcast analogue: every process returns root's values."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(
+        tree, is_source=jax.process_index() == root)
+
+
+class CachedAllreduce:
+    """Lazy-prepare allreduce (rabit's ``Allreduce(ptr, n, prepare_fn)``).
+
+    ``run(prepare_fn)`` calls ``prepare_fn`` to build the local buffer and
+    reduces it; after a checkpoint restore the cached result for the same
+    sequence number is replayed without recomputation — the property rabit
+    uses for cheap recovery (kmeans.cc:177-179)."""
+
+    def __init__(self, mesh: Mesh) -> None:
+        self.mesh = mesh
+        self.seqno = 0
+        self._cache: dict = {}
+
+    def run(self, prepare_fn: Callable[[], Any], op: str = "sum") -> Any:
+        if self.seqno in self._cache:
+            out = self._cache[self.seqno]
+        else:
+            out = allreduce_tree(prepare_fn(), self.mesh, op)
+            self._cache[self.seqno] = out
+        self.seqno += 1
+        return out
+
+    def restore(self, seqno: int, cache: Optional[dict] = None) -> None:
+        self.seqno = seqno
+        self._cache = dict(cache or {})

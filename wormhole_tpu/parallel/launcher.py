@@ -1,0 +1,107 @@
+"""Job launcher — the ``dmlc_local.py`` / ``dmlc_yarn.py`` analogue.
+
+Reference trackers spawn N worker + S server processes and wire them up by
+env (SURVEY.md §1 L6, ``learn/linear/guide/demo_local.sh:3``). On TPU the
+roles collapse into one SPMD program, so the launcher's jobs are:
+
+- ``--cluster sim``   : run the app in ONE process with N *virtual* CPU
+  devices (``--xla_force_host_platform_device_count``) — the local testing
+  story, matching ``dmlc_local.py`` ergonomics without any networking.
+- ``--cluster mp``    : spawn N local processes joined through
+  ``jax.distributed.initialize`` over localhost — exercises the real
+  multi-controller runtime (the DCN path) on one machine.
+- ``--cluster tpu``   : exec the app unchanged on every host of a pod slice
+  (the pod runtime injects coordinator/topology; we only validate env).
+
+Usage:  python -m wormhole_tpu.parallel.launcher -n 8 [--cluster sim] -- \
+            python your_app.py key=val ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _base_env() -> dict:
+    """Child env for the CPU simulation modes.
+
+    Ships the framework to the child like dmlc_local.py ships its binaries
+    (repo root on PYTHONPATH), and removes site hooks that force-register an
+    accelerator backend at interpreter start — they would both defeat
+    JAX_PLATFORMS=cpu and initialize XLA before jax.distributed.initialize
+    can run. The `tpu` cluster mode leaves the env untouched."""
+    env = dict(os.environ)
+    pp = [p for p in env.get("PYTHONPATH", "").split(":")
+          if p and "axon" not in p]
+    cwd = os.getcwd()
+    if cwd not in pp:
+        pp.insert(0, cwd)
+    env["PYTHONPATH"] = ":".join(pp)
+    return env
+
+
+def launch_sim(n: int, cmd: List[str]) -> int:
+    env = _base_env()
+    xla = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = f"{xla} --xla_force_host_platform_device_count={n}".strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.call(cmd, env=env)
+
+
+def launch_mp(n: int, cmd: List[str]) -> int:
+    port = _free_port()
+    procs = []
+    for i in range(n):
+        env = _base_env()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"] = str(n)
+        env["PROCESS_ID"] = str(i)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_tpu(cmd: List[str]) -> int:
+    # On a pod slice each host runs this identically; JAX's TPU runtime
+    # discovers topology itself. Nothing to inject.
+    return subprocess.call(cmd)
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "wormhole-tpu launcher",
+        description="dmlc tracker analogue for TPU/SPMD jobs")
+    ap.add_argument("-n", "--num-devices", type=int, default=8,
+                    help="virtual devices (sim) or processes (mp)")
+    ap.add_argument("--cluster", choices=("sim", "mp", "tpu"), default="sim")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- command to launch")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (append: -- python app.py ...)")
+    if args.cluster == "sim":
+        return launch_sim(args.num_devices, cmd)
+    if args.cluster == "mp":
+        return launch_mp(args.num_devices, cmd)
+    return launch_tpu(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Device-mesh runtime: the TPU replacement for tracker + node roles.
+
+The reference runs scheduler/server/worker *processes* wired by a tracker
+(SURVEY.md §1 L6, ``dmlc-core/tracker``). On TPU the equivalent runtime is:
+one Python process per host, all devices joined in a ``jax.sharding.Mesh``,
+SPMD programs compiled with pjit over named axes. Axis conventions:
+
+- ``data``  — batch/data parallelism (rabit-style BSP reductions ride here)
+- ``model`` — parameter/feature sharding (the ps-lite key-range analogue and
+  the L-BFGS feature-range partition, lbfgs.h:126-136)
+
+``rank``/``world`` map to ``jax.process_index``/``process_count`` (the rabit
+GetRank/GetWorldSize surface); each host reads input part ``rank/world``
+exactly like a reference worker.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def parse_mesh_shape(spec: str, num_devices: int) -> Tuple[Tuple[str, int], ...]:
+    """Parse "data:4,model:2" → (("data",4),("model",2)); empty = all data."""
+    if not spec:
+        return ((DATA_AXIS, num_devices),)
+    axes = []
+    for part in spec.split(","):
+        name, _, n = part.partition(":")
+        axes.append((name.strip(), int(n)))
+    total = int(np.prod([n for _, n in axes]))
+    if total != num_devices:
+        raise ValueError(f"mesh {spec!r} wants {total} devices, "
+                         f"have {num_devices}")
+    return tuple(axes)
+
+
+def make_mesh(spec: str = "", devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    axes = parse_mesh_shape(spec, len(devices))
+    names = tuple(a for a, _ in axes)
+    shape = tuple(n for _, n in axes)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def ensure_platform() -> None:
+    """Make the JAX_PLATFORMS env var authoritative.
+
+    Site hooks (accelerator plugins registered from sitecustomize) can
+    override the platform choice before user code runs; launcher-driven
+    simulation (``--cluster sim`` sets JAX_PLATFORMS=cpu) must win. Safe
+    only before the first backend initialization."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass  # backend already initialized; keep whatever is live
+
+
+def distributed_init() -> None:
+    """Join a multi-host job (rabit::Init analogue).
+
+    No-op without cluster env; with COORDINATOR_ADDRESS set (by the mp
+    launcher or a pod runtime) calls ``jax.distributed.initialize`` — which
+    must happen before anything touches the backend, so this probes the
+    already-initialized state via jax's distributed global state, never via
+    ``jax.process_count()``."""
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already joined
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PROCESS_ID", "0")))
+
+
+@dataclass
+class MeshRuntime:
+    """Bundle of mesh + rank/world + sharding helpers passed to the apps."""
+
+    mesh: Mesh
+
+    @classmethod
+    def create(cls, mesh_spec: str = "") -> "MeshRuntime":
+        ensure_platform()
+        distributed_init()
+        return cls(mesh=make_mesh(mesh_spec))
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world(self) -> int:
+        return jax.process_count()
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size
+
+    @property
+    def data_axis_size(self) -> int:
+        return self.mesh.shape.get(DATA_AXIS, 1)
+
+    @property
+    def model_axis_size(self) -> int:
+        return self.mesh.shape.get(MODEL_AXIS, 1)
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def local_part(self, total_parts: int = 0) -> Tuple[int, int]:
+        """(part, nparts) for this host's input shard — the reference's
+        ``RowBlockIter::Create(uri, rank, world, ...)`` convention."""
+        return self.rank, max(self.world, 1)

@@ -1,0 +1,3 @@
+from wormhole_tpu.utils.config import Config, load_config
+from wormhole_tpu.utils.progress import Progress
+from wormhole_tpu.utils.timer import Timer

@@ -1,0 +1,208 @@
+"""Typed configuration with text-file + ``key=val`` CLI override merging.
+
+TPU-native rebuild of the reference's three config styles (SURVEY.md §5.6):
+protobuf-text conf files merged with CLI overrides (reference
+``learn/linear/base/arg_parser.h:13-64`` + ``proto/config.proto:6-110``) and the
+``param=val`` SetParam chains of the rabit apps
+(``learn/lbfgs-linear/linear.cc:236-241``). Here a single dataclass-backed
+parser covers both: conf files hold one ``key = value`` (or ``key: value``)
+per line, CLI args are ``key=value`` tokens, CLI merges over file (same
+precedence as the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence
+
+
+class Loss(enum.Enum):
+    SQUARE = "square"
+    LOGIT = "logit"
+    HINGE = "hinge"
+    SQUARE_HINGE = "square_hinge"
+
+
+class Penalty(enum.Enum):
+    L1 = "l1"
+    L2 = "l2"
+
+
+class Algo(enum.Enum):
+    # (minibatch) online methods
+    SGD = "sgd"
+    ADAGRAD = "adagrad"
+    FTRL = "ftrl"
+    # batch methods
+    LBFGS = "lbfgs"
+    # delay tolerant, experimental
+    DT_SGD = "dt_sgd"
+    DT_ADAGRAD = "dt_adagrad"
+    DT2_ADAGRAD = "dt2_adagrad"
+
+
+@dataclass
+class Config:
+    """Mirror of the reference Config schema (``proto/config.proto:6-110``),
+    extended with TPU-runtime knobs (mesh shape, bucket count, dtype)."""
+
+    # --- data ---
+    train_data: str = ""
+    val_data: str = ""
+    test_data: str = ""
+    data_format: str = "libsvm"
+    num_parts_per_file: int = 1
+
+    # --- model ---
+    model_in: str = ""
+    model_out: str = ""
+
+    loss: Loss = Loss.LOGIT
+    penalty: Penalty = Penalty.L1
+    lambda_: List[float] = field(default_factory=list)  # "lambda" in the reference
+
+    # --- optimization ---
+    algo: Algo = Algo.FTRL
+    minibatch: int = 1000
+    max_data_pass: int = 10
+    disp_itv: float = 1.0
+    epsilon: float = 1e-4
+    max_objv: float = 0.0  # 0 = unset; stop if objv >= max_objv
+
+    lr_eta: float = 0.1
+    lr_beta: float = 1.0
+    lr_theta: float = 1.0
+
+    # --- sync-cost reduction ---
+    max_delay: int = 0
+    key_cache: bool = True
+    msg_compression: bool = True
+    fixed_bytes: int = 1
+    tail_feature_freq: int = 0
+
+    init_workload: int = 0
+    init_num_worker: int = 1
+
+    # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
+    max_lbfgs_iter: int = 100
+    lbfgs_memory: int = 10  # size_memory
+    reg_L1: float = 0.0
+    reg_L2: float = 0.0
+    linesearch_c1: float = 1e-4
+    linesearch_backoff: float = 0.5
+    max_linesearch_iter: int = 30
+    min_lbfgs_iter: int = 5
+
+    # --- TPU runtime (new; no reference analogue) ---
+    num_buckets: int = 1 << 20  # hashed parameter-bucket count (FLAGS_max_key analogue)
+    max_nnz: int = 0            # 0 = derive from data; per-row padded nnz
+    mesh_shape: str = ""        # e.g. "data:4,model:2"; empty = all devices on "data"
+    param_dtype: str = "float32"
+    seed: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0   # iterations; 0 = off
+
+    def merged(self, kvs: Sequence[str]) -> "Config":
+        """Return a copy with ``key=value`` tokens merged over this config."""
+        out = dataclasses.replace(self)
+        _apply_kvs(out, kvs)
+        return out
+
+
+_ALIASES = {
+    "lambda": "lambda_",
+    "size_memory": "lbfgs_memory",
+    "max_iter": "max_lbfgs_iter",
+}
+
+
+def _coerce(ftype: Any, raw: str) -> Any:
+    """Coerce a raw string to the declared field type."""
+    raw = raw.strip().strip("'\"")
+    origin = typing.get_origin(ftype)
+    if origin in (list, List):
+        (inner,) = typing.get_args(ftype)
+        items = [p for p in raw.replace(",", " ").split() if p]
+        return [_coerce(inner, p) for p in items]
+    if origin is typing.Union:  # Optional[...]
+        inner = [a for a in typing.get_args(ftype) if a is not type(None)]
+        return _coerce(inner[0], raw)
+    if isinstance(ftype, type) and issubclass(ftype, enum.Enum):
+        key = raw.lower()
+        for m in ftype:
+            if m.value == key or m.name.lower() == key:
+                return m
+        raise ValueError(f"unknown {ftype.__name__} value: {raw!r}")
+    if ftype is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ftype is int:
+        return int(float(raw))
+    if ftype is float:
+        return float(raw)
+    return raw
+
+
+def _apply_kvs(cfg: Config, kvs: Sequence[str]) -> None:
+    hints = typing.get_type_hints(Config)
+    for tok in kvs:
+        tok = tok.strip()
+        if not tok or tok.startswith("#"):
+            continue
+        if "=" in tok:
+            key, _, val = tok.partition("=")
+        elif ":" in tok:
+            key, _, val = tok.partition(":")
+        else:
+            raise ValueError(f"cannot parse config token {tok!r} (want key=val)")
+        key = key.strip()
+        key = _ALIASES.get(key, key)
+        if not hasattr(cfg, key):
+            raise ValueError(f"unknown config key {key!r}")
+        setattr(cfg, key, _coerce(hints[key], val))
+
+
+def _append_repeated(lines: List[str]) -> List[str]:
+    """Collapse repeated keys (proto2 ``repeated`` semantics) into one list token.
+
+    ``lambda = 1`` + ``lambda = 0.1`` becomes ``lambda = 1 0.1``, matching the
+    reference's repeated-field conf style (``guide/criteo_s3.conf``)."""
+    hints = typing.get_type_hints(Config)
+    merged: dict = {}
+    order: List[str] = []
+    for ln in lines:
+        key = _ALIASES.get(ln.partition("=")[0].partition(":")[0].strip(),
+                           ln.partition("=")[0].partition(":")[0].strip())
+        is_rep = key in hints and typing.get_origin(hints[key]) in (list, List)
+        val = ln.partition("=")[2] if "=" in ln else ln.partition(":")[2]
+        if key not in merged:
+            merged[key] = []
+            order.append(key)
+        if is_rep:
+            merged[key].append(val.strip())
+        else:
+            merged[key] = [val.strip()]
+    return [f"{k}={' '.join(merged[k])}" for k in order]
+
+
+def load_config(path: Optional[str] = None,
+                argv: Sequence[str] = (),
+                base: Optional[Config] = None) -> Config:
+    """Load a conf file then merge ``key=value`` CLI tokens over it.
+
+    Matches reference precedence: file first, CLI overrides
+    (``arg_parser.h:36-45``)."""
+    cfg = dataclasses.replace(base) if base is not None else Config()
+    if path:
+        from wormhole_tpu.data.stream import open_stream
+        with open_stream(path, "r") as f:
+            text = f.read()
+        if isinstance(text, bytes):
+            text = text.decode("utf-8")
+        lines = [ln.strip() for ln in text.splitlines()
+                 if ln.strip() and not ln.strip().startswith("#")]
+        _apply_kvs(cfg, _append_repeated(lines))
+    _apply_kvs(cfg, list(argv))
+    return cfg

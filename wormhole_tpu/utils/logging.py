@@ -1,0 +1,24 @@
+"""Logging + CHECK macros (reference dmlc/logging.h usage)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_logger = logging.getLogger("wormhole_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "[%(asctime)s] %(levelname)s %(name)s: %(message)s", "%H:%M:%S"))
+    _logger.addHandler(h)
+    _logger.setLevel(logging.INFO)
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return _logger.getChild(name) if name else _logger
+
+
+def check(cond: bool, msg: str = "") -> None:
+    """CHECK(cond) — raise on failure like dmlc's CHECK macros."""
+    if not cond:
+        raise AssertionError(f"Check failed: {msg}")

@@ -1,0 +1,142 @@
+"""Progress metrics + monitor chain.
+
+Rebuild of the reference's fixed-layout ``Progress`` POD (10 doubles + 10
+int64s with raw-memcpy Serialize/Parse/Merge, ``learn/linear/base/monitor.h:11-82``)
+and the worker/model monitor + rate-limited reporter chain
+(``monitor.h:89-145``, ``base/dist_monitor.h:8-48``). Here the POD is a numpy
+record that merges by elementwise add; the "side channel to the scheduler"
+becomes either an in-process queue (single host) or a psum over the mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+_NF = 10  # float slots
+_NI = 10  # int slots
+
+# slot names, mirroring monitor.h field accessors
+_F_SLOTS = ["objv", "acc", "auc", "objv_w", "wdelta2"]
+_I_SLOTS = ["count", "num_ex", "nnz_w", "nnz_delta", "new_ex"]
+
+
+@dataclass
+class Progress:
+    """Fixed-layout mergeable metric vector.
+
+    ``fvec``/``ivec`` always have length 10 each, so serialization is a fixed
+    160-byte buffer and Merge is a vector add — same contract as the
+    reference POD."""
+
+    fvec: np.ndarray = field(default_factory=lambda: np.zeros(_NF, np.float64))
+    ivec: np.ndarray = field(default_factory=lambda: np.zeros(_NI, np.int64))
+
+    # --- named accessors ---
+    def _fget(self, name: str) -> float:
+        return float(self.fvec[_F_SLOTS.index(name)])
+
+    def _fset(self, name: str, v: float) -> None:
+        self.fvec[_F_SLOTS.index(name)] = v
+
+    def _iget(self, name: str) -> int:
+        return int(self.ivec[_I_SLOTS.index(name)])
+
+    def _iset(self, name: str, v: int) -> None:
+        self.ivec[_I_SLOTS.index(name)] = v
+
+    objv = property(lambda s: s._fget("objv"), lambda s, v: s._fset("objv", v))
+    acc = property(lambda s: s._fget("acc"), lambda s, v: s._fset("acc", v))
+    auc = property(lambda s: s._fget("auc"), lambda s, v: s._fset("auc", v))
+    objv_w = property(lambda s: s._fget("objv_w"), lambda s, v: s._fset("objv_w", v))
+    wdelta2 = property(lambda s: s._fget("wdelta2"), lambda s, v: s._fset("wdelta2", v))
+    count = property(lambda s: s._iget("count"), lambda s, v: s._iset("count", v))
+    num_ex = property(lambda s: s._iget("num_ex"), lambda s, v: s._iset("num_ex", v))
+    nnz_w = property(lambda s: s._iget("nnz_w"), lambda s, v: s._iset("nnz_w", v))
+
+    # --- POD contract ---
+    def serialize(self) -> bytes:
+        return self.fvec.tobytes() + self.ivec.tobytes()
+
+    @classmethod
+    def parse(cls, data: bytes) -> "Progress":
+        f = np.frombuffer(data[: _NF * 8], np.float64).copy()
+        i = np.frombuffer(data[_NF * 8:], np.int64).copy()
+        return cls(f, i)
+
+    def merge(self, other: "Progress") -> "Progress":
+        self.fvec += other.fvec
+        self.ivec += other.ivec
+        return self
+
+    def clear(self) -> None:
+        self.fvec[:] = 0
+        self.ivec[:] = 0
+
+    def empty(self) -> bool:
+        return self.num_ex == 0 and self.count == 0
+
+    # --- display (reference scheduler progress row, async_sgd.h:306-320) ---
+    HEADER = "  sec  #example delta #ex    |w|_0       logloss     AUC    accuracy"
+
+    def print_row(self, elapsed: float, prev_num_ex: int = 0) -> str:
+        n = max(self.num_ex, 1)
+        return (f"{elapsed:5.0f}  {self.num_ex:.2e}  {self.num_ex - prev_num_ex:.2e}"
+                f"  {self.nnz_w:.2e}  {self.objv / n:10.6f}  {self.auc / max(self.count, 1):.6f}"
+                f"  {self.acc / max(self.count, 1):.6f}")
+
+
+class WorkerMonitor:
+    """Accumulates per-minibatch loss metrics (``monitor.h:133-145``)."""
+
+    def __init__(self) -> None:
+        self.prog = Progress()
+
+    def update(self, num_ex: int, objv: float, auc: float, acc: float) -> None:
+        p = self.prog
+        p.num_ex += num_ex
+        p.count += 1
+        p.objv += objv
+        p.auc += auc
+        p.acc += acc
+
+    def fetch_and_clear(self) -> Progress:
+        out = Progress(self.prog.fvec.copy(), self.prog.ivec.copy())
+        self.prog.clear()
+        return out
+
+
+class ModelMonitor:
+    """Tracks nnz(w) and weight-delta norms per update (``monitor.h:89-125``)."""
+
+    def __init__(self) -> None:
+        self.prog = Progress()
+
+    def update_delta(self, nnz_new: int, nnz_old: int, wdelta2: float) -> None:
+        self.prog.ivec[_I_SLOTS.index("nnz_delta")] += nnz_new - nnz_old
+        self.prog.wdelta2 += wdelta2
+
+    def set_nnz(self, nnz: int) -> None:
+        self.prog.nnz_w = nnz
+
+
+class TimeReporter:
+    """Rate-limits metric reports (``dist_monitor.h:8-38``)."""
+
+    def __init__(self, report_fn: Callable[[Progress], None],
+                 interval: float = 1.0) -> None:
+        self._fn = report_fn
+        self._itv = interval
+        self._last = 0.0
+
+    def report(self, monitor: WorkerMonitor, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last < self._itv:
+            return
+        prog = monitor.fetch_and_clear()
+        if not prog.empty() or force:
+            self._fn(prog)
+        self._last = now

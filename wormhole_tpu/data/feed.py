@@ -50,6 +50,32 @@ class SparseBatch:
         return int(np.asarray(self.row_mask).sum())
 
 
+def _scatter_padded(blk: RowBlock, mb: int, max_nnz: int):
+    """Shared CSR→padded-dense scatter: (cols, vals, labels, row_mask).
+
+    Rows with more than ``max_nnz`` entries are truncated positionally (the
+    first ``max_nnz`` entries in storage order are kept)."""
+    n = blk.size
+    assert n <= mb, (n, mb)
+    cols = np.zeros((mb, max_nnz), np.int32)
+    vals = np.zeros((mb, max_nnz), np.float32)
+    if blk.nnz:
+        per_row = np.diff(blk.offset).astype(np.int64)
+        row_ids = np.repeat(np.arange(n, dtype=np.int64), per_row)
+        pos = np.arange(blk.nnz, dtype=np.int64) - np.repeat(
+            blk.offset[:-1].astype(np.int64), per_row)
+        keep = pos < max_nnz
+        cols[row_ids[keep], pos[keep]] = blk.index[keep].astype(np.int64)
+        vals[row_ids[keep], pos[keep]] = blk.values_or_ones()[keep]
+    labels = np.zeros(mb, np.float32)
+    labels[:n] = blk.label
+    row_mask = np.zeros(mb, np.float32)
+    row_mask[:n] = 1.0
+    if blk.weight is not None:
+        row_mask[:n] = blk.weight
+    return cols, vals, labels, row_mask
+
+
 def next_bucket(n: int, minimum: int = 256) -> int:
     """Round up to a power of two (shape-bucketing to bound recompiles)."""
     b = minimum
@@ -71,28 +97,7 @@ def pad_to_batch(loc: Localized, minibatch_size: int,
     would corrupt parameter pull/push, so it raises instead."""
     blk = loc.block
     mb = minibatch_size
-    n = blk.size
-    assert n <= mb, (n, mb)
-
-    cols = np.zeros((mb, max_nnz), np.int32)
-    vals = np.zeros((mb, max_nnz), np.float32)
-    per_row = np.diff(blk.offset).astype(np.int64)
-    values = blk.values_or_ones()
-
-    if blk.nnz:
-        row_ids = np.repeat(np.arange(n, dtype=np.int64), per_row)
-        pos = np.arange(blk.nnz, dtype=np.int64) - np.repeat(
-            blk.offset[:-1].astype(np.int64), per_row)
-        keep = pos < max_nnz  # rows beyond max_nnz are truncated
-        cols[row_ids[keep], pos[keep]] = blk.index[keep]
-        vals[row_ids[keep], pos[keep]] = values[keep]
-
-    labels = np.zeros(mb, np.float32)
-    labels[:n] = blk.label
-    row_mask = np.zeros(mb, np.float32)
-    row_mask[:n] = 1.0
-    if blk.weight is not None:
-        row_mask[:n] = blk.weight
+    cols, vals, labels, row_mask = _scatter_padded(blk, mb, max_nnz)
 
     k = len(loc.uniq_keys)
     kpad = key_pad or next_bucket(k)
@@ -113,3 +118,39 @@ def pad_to_batch(loc: Localized, minibatch_size: int,
 
 def batch_max_nnz(blk: RowBlock, cap: int = 4096) -> int:
     return min(next_bucket(max(blk.max_row_nnz(), 1), 8), cap)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DenseBatch:
+    """Fixed-shape padded batch in *global* feature space (no localization).
+
+    Used by the BSP apps (k-means, L-BFGS linear) whose model lives as a
+    full dense array over all ``num_features`` columns — the reference's
+    ``RowBlockIter`` path (kmeans.cc:155-160, lbfgs-linear/linear.cc:229-234)
+    where feature ids index the model directly.
+    """
+
+    cols: jax.Array      # int32 (mb, max_nnz) global feature id; 0 on padding
+    vals: jax.Array      # f32   (mb, max_nnz); 0 on padding
+    labels: jax.Array    # f32   (mb,)
+    row_mask: jax.Array  # f32   (mb,)
+
+    @property
+    def batch_size(self) -> int:
+        return self.cols.shape[0]
+
+
+def pad_block_global(blk: RowBlock, minibatch_size: int,
+                     max_nnz: int) -> DenseBatch:
+    """Pad a RowBlock (global uint64 ids) into a DenseBatch.
+
+    Feature ids must fit int32 (use Localizer bucket folding upstream for
+    hashed 64-bit spaces). Rows with more than ``max_nnz`` entries are
+    truncated positionally."""
+    if blk.nnz and blk.max_index() > np.iinfo(np.int32).max:
+        raise OverflowError(
+            f"feature id {blk.max_index()} exceeds int32; fold the key space")
+    cols, vals, labels, row_mask = _scatter_padded(
+        blk, minibatch_size, max_nnz)
+    return DenseBatch(cols=cols, vals=vals, labels=labels, row_mask=row_mask)

@@ -110,6 +110,8 @@ class RowBlockContainer:
         self._value_chunks.append(blk.value if blk.value is not None else None)
         if blk.value is not None:
             self._has_value = True
+        if blk.weight is not None:
+            self._has_weight = True
         self._labels.extend(blk.label.tolist())
         self._weights.extend([1.0] * blk.size if blk.weight is None
                              else blk.weight.tolist())

@@ -1,0 +1,1 @@
+"""Applications (reference ``learn/*`` tools, rebuilt TPU-first)."""

@@ -1,0 +1,276 @@
+"""BSP spherical k-means (cosine distance), TPU-native.
+
+Rebuild of the reference k-means tool (``learn/kmeans/kmeans.cc:25-278`` and
+the numpy variant ``learn/kmeans/kmeans.py``): each iteration every worker
+assigns its rows to the nearest centroid by cosine similarity, accumulates
+per-cluster feature sums + counts, one Sum-allreduce over the ``K×(F+1)``
+stats matrix, then recompute + L2-normalize centroids; checkpoint each
+iteration (rabit ``LazyCheckPoint``, kmeans.cc:264).
+
+TPU mapping (SURVEY.md §7 stage 3): the OMP assignment loop
+(kmeans.cc:200-247) becomes one jitted sparse-dense contraction on the MXU —
+scores = X·Cᵀ via gather+einsum over the padded CSR batch — and the stats
+accumulation a scatter-add; the rabit ``Allreduce<Sum>`` over stats becomes
+XLA's cross-device reduction (batch sharded over the ``data`` mesh axis,
+stats replicated) plus a host-level process allreduce for multi-host. The
+lazy-prepare fault-tolerance hook survives as the versioned Checkpointer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from wormhole_tpu.data.feed import DenseBatch, next_bucket, pad_block_global
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.parallel.checkpoint import Checkpointer
+from wormhole_tpu.parallel.collectives import allreduce_tree
+from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("kmeans")
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KMeansState:
+    """Checkpointable model state (reference Model, kmeans.cc:55-90)."""
+
+    centroids: jax.Array  # f32 (K, F), rows L2-normalized
+    version: jax.Array = field(
+        default_factory=lambda: np.zeros((), np.int32))
+
+
+def normalize_rows(m: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """L2-normalize rows (reference Model::Normalize, kmeans.cc:80-89)."""
+    norm = jnp.sqrt(jnp.sum(m * m, axis=-1, keepdims=True))
+    return m / jnp.maximum(norm, eps)
+
+
+def _assign_batch(centroids_t: jax.Array, batch: DenseBatch):
+    """Cluster assignment for one padded batch.
+
+    scores[b, k] = Σ_j vals[b,j] · C[k, cols[b,j]]  (the sparse X·Cᵀ).
+    Returns (assign (mb,) int32, max_cos (mb,), xnorm (mb,))."""
+    gathered = centroids_t[batch.cols]                 # (mb, nnz, K)
+    scores = jnp.einsum("bnk,bn->bk", gathered, batch.vals)
+    assign = jnp.argmax(scores, axis=-1).astype(jnp.int32)
+    best = jnp.max(scores, axis=-1)
+    xnorm = jnp.sqrt(jnp.sum(batch.vals * batch.vals, axis=-1))
+    cos = best / jnp.maximum(xnorm, 1e-12)
+    return assign, cos, xnorm
+
+
+def _accumulate(stats, centroids_t: jax.Array, batch: DenseBatch):
+    """One minibatch of the stats pass (reference omp_get_centroid lambda,
+    kmeans.cc:200-247): assign rows, scatter feature sums + counts."""
+    sums, counts, objv, seen = stats
+    assign, cos, _ = _assign_batch(centroids_t, batch)
+    w = batch.row_mask                                  # 0 for padded rows
+    # scatter each entry's value into its cluster's feature-sum row
+    entry_w = (batch.vals * w[:, None]).reshape(-1)
+    entry_cluster = jnp.broadcast_to(
+        assign[:, None], batch.cols.shape).reshape(-1)
+    sums = sums.at[entry_cluster, batch.cols.reshape(-1)].add(entry_w)
+    counts = counts.at[assign].add(w)
+    objv = objv + jnp.sum((1.0 - cos) * w)
+    seen = seen + jnp.sum(w)
+    return sums, counts, objv, seen
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accumulate_jit(stats, centroids_t, batch):
+    return _accumulate(stats, centroids_t, batch)
+
+
+_assign_batch_jit = jax.jit(_assign_batch)
+
+
+@jax.jit
+def _recompute(state: KMeansState, sums: jax.Array,
+               counts: jax.Array) -> KMeansState:
+    """New centroids = normalize(sum/count); empty clusters keep their old
+    centroid (reference keeps stale rows when count underflows)."""
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    fresh = normalize_rows(sums / safe)
+    keep_old = (counts <= 0.0)[:, None]
+    cent = jnp.where(keep_old, state.centroids, fresh)
+    return KMeansState(centroids=cent, version=state.version + 1)
+
+
+@dataclass
+class KMeansConfig:
+    num_clusters: int = 10
+    num_features: int = 0          # 0 = derive from data (Allreduce<Max> of fdim)
+    max_iter: int = 10
+    minibatch_size: int = 1024
+    max_nnz: int = 0               # 0 = derive per-batch bucket
+    seed: int = 0
+    checkpoint_dir: str = ""
+    objv_tol: float = 0.0          # stop when |Δobjv|/n < tol (0 = run max_iter)
+
+
+class KMeans:
+    """Host-side driver (reference main loop, kmeans.cc:153-278)."""
+
+    def __init__(self, cfg: KMeansConfig, runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.rt = runtime or MeshRuntime.create()
+        self.ckpt = Checkpointer(cfg.checkpoint_dir)
+        self.state: Optional[KMeansState] = None
+        self.history: List[float] = []  # mean (1-cos) objective per iter
+
+    # -- data ---------------------------------------------------------------
+
+    def load_batches(self, uri: str, data_format: str = "libsvm",
+                     part: Optional[int] = None,
+                     nparts: Optional[int] = None) -> List[DenseBatch]:
+        """Read this host's shard and pad to device batches, cached in HBM.
+
+        Mirrors ``RowBlockIter::Create(uri, rank, world)`` (kmeans.cc:155-160)
+        but keeps the padded batches resident so later passes are free."""
+        if part is None or nparts is None:
+            part, nparts = self.rt.local_part()
+        mb = self.cfg.minibatch_size
+        it = MinibatchIter(uri, part, nparts, data_format, mb)
+        batches, fdim = [], self.cfg.num_features
+        blocks = list(it)
+        if not self.cfg.num_features:
+            local_max = max((b.max_index() for b in blocks), default=0)
+            fdim = int(allreduce_tree(np.int64(local_max + 1),
+                                      self.rt.mesh, "max"))
+            self.cfg.num_features = fdim
+        nnz = self.cfg.max_nnz or max(
+            (next_bucket(b.max_row_nnz(), 8) for b in blocks), default=8)
+        self.cfg.max_nnz = nnz
+        sharding = self._batch_sharding()
+        for blk in blocks:
+            db = pad_block_global(blk, mb, nnz)
+            batches.append(jax.device_put(db, sharding))
+        return batches
+
+    def _batch_sharding(self):
+        mesh = self.rt.mesh
+        if DATA_AXIS not in mesh.axis_names or self.rt.data_axis_size == 1:
+            return None
+
+        def spec(x):
+            return NamedSharding(
+                mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+        return jax.tree.map(
+            spec, DenseBatch(cols=np.zeros((1, 1), np.int32),
+                             vals=np.zeros((1, 1), np.float32),
+                             labels=np.zeros(1, np.float32),
+                             row_mask=np.zeros(1, np.float32)))
+
+    # -- init ---------------------------------------------------------------
+
+    def init_centroids(self, batches: List[DenseBatch]) -> KMeansState:
+        """Pick K random real rows as initial centroids (reference
+        InitCentroids, kmeans.cc:92-109: random rows, broadcast from a random
+        proc). Multi-host: rank 0's choice is broadcast via the host
+        collective."""
+        k, f = self.cfg.num_clusters, self.cfg.num_features
+        rng = np.random.default_rng(self.cfg.seed)
+        cent = np.zeros((k, f), np.float32)
+        picked = 0
+        order = rng.permutation(len(batches)) if batches else []
+        for bi in order:
+            b = batches[bi]
+            cols = np.asarray(b.cols)
+            vals = np.asarray(b.vals)
+            mask = np.asarray(b.row_mask)
+            rows = np.nonzero(mask > 0)[0]
+            rng.shuffle(rows)
+            for r in rows:
+                if picked == k:
+                    break
+                real = vals[r] != 0  # skip padding (col 0 / val 0) entries
+                np.add.at(cent[picked], cols[r][real], vals[r][real])
+                picked += 1
+            if picked == k:
+                break
+        if picked < k:
+            cent[picked:] = rng.standard_normal((k - picked, f)) * 0.01
+        from wormhole_tpu.parallel.collectives import broadcast_tree
+        cent = broadcast_tree(cent, self.rt.mesh, root=0)
+        state = KMeansState(
+            centroids=np.asarray(normalize_rows(jnp.asarray(cent))),
+            version=np.zeros((), np.int32))
+        return state
+
+    # -- training -----------------------------------------------------------
+
+    def one_iteration(self, state: KMeansState,
+                      batches: Iterable[DenseBatch]) -> tuple:
+        """One BSP round: stream batches through the jitted accumulator,
+        allreduce stats across hosts, recompute centroids."""
+        k, f = self.cfg.num_clusters, self.cfg.num_features
+        cent_t = jnp.asarray(state.centroids).T  # (F, K)
+        stats = (jnp.zeros((k, f), jnp.float32), jnp.zeros(k, jnp.float32),
+                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        for batch in batches:
+            stats = _accumulate_jit(stats, cent_t, batch)
+        sums, counts, objv, seen = jax.tree.map(np.asarray, stats)
+        # cross-host Sum-allreduce (rabit::Allreduce<Sum>, kmeans.cc:249)
+        sums, counts, objv, seen = allreduce_tree(
+            (sums, counts, objv, seen), self.rt.mesh, "sum")
+        new_state = _recompute(state, jnp.asarray(sums), jnp.asarray(counts))
+        mean_objv = float(objv) / max(float(seen), 1.0)
+        return new_state, mean_objv
+
+    def fit(self, batches: List[DenseBatch]) -> KMeansState:
+        template = self.state or self.init_centroids(batches)
+        version, state = self.ckpt.load(template)
+        if version:
+            log.info("restart from version=%d", version)
+        self.state = state
+        prev = None
+        for it in range(version, self.cfg.max_iter):
+            self.state, objv = self.one_iteration(self.state, batches)
+            self.history.append(objv)
+            log.info("iter %d: mean(1-cos)=%.6f", it, objv)
+            self.ckpt.lazy_save(it + 1, self.state)
+            if (self.cfg.objv_tol > 0 and prev is not None
+                    and abs(prev - objv) < self.cfg.objv_tol):
+                break
+            prev = objv
+        return self.state
+
+    def predict(self, batch: DenseBatch) -> np.ndarray:
+        cent_t = jnp.asarray(self.state.centroids).T
+        assign, _, _ = _assign_batch_jit(cent_t, batch)
+        return np.asarray(assign)
+
+    # -- model IO (reference Model::Load/Save + rank-0 text dump,
+    #    kmeans.cc:55-79, 272-277) ------------------------------------------
+
+    def save_model(self, path: str) -> None:
+        if self.rt.rank != 0:
+            return
+        from wormhole_tpu.data.stream import open_stream
+        cent = np.asarray(self.state.centroids)
+        with open_stream(path, "w") as f:
+            for row in cent:
+                f.write(" ".join(f"{v:.6g}" for v in row) + "\n")
+
+    def load_model(self, path: str) -> KMeansState:
+        from wormhole_tpu.data.stream import open_stream
+        with open_stream(path, "r") as f:
+            text = f.read()
+        if isinstance(text, bytes):
+            text = text.decode()
+        rows = [[float(v) for v in ln.split()]
+                for ln in text.splitlines() if ln.strip()]
+        cent = np.asarray(rows, np.float32)
+        self.cfg.num_clusters, self.cfg.num_features = cent.shape
+        self.state = KMeansState(centroids=cent,
+                                 version=np.zeros((), np.int32))
+        return self.state

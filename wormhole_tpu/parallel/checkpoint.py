@@ -51,8 +51,13 @@ class Checkpointer:
         if ver == 0:
             return 0, template
         path = self._path(ver)
+        import jax
+        leaves, treedef = jax.tree.flatten(template)
         with open(path, "rb") as f:
-            state = serialization.from_bytes(template, f.read())
+            new_leaves = serialization.from_bytes(
+                {str(i): leaf for i, leaf in enumerate(leaves)}, f.read())
+        state = jax.tree.unflatten(
+            treedef, [new_leaves[str(i)] for i in range(len(leaves))])
         log.info("restart from version=%d (%s)", ver, path)
         return ver, state
 
@@ -61,8 +66,11 @@ class Checkpointer:
         if not self.dir or not self.is_writer:
             return
         import jax
-        state = jax.tree.map(_to_host, state)
-        data = serialization.to_bytes(state)
+        # flatten to an index-keyed dict of host arrays: msgpack can't walk
+        # arbitrary registered dataclasses, but any pytree flattens
+        leaves = jax.tree.leaves(jax.tree.map(_to_host, state))
+        data = serialization.to_bytes(
+            {str(i): leaf for i, leaf in enumerate(leaves)})
         path = self._path(version)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:

@@ -1,0 +1,149 @@
+"""VL-BFGS solver + linear app tests: quadratic oracle, scipy parity on
+logistic regression, OWL-QN sparsity, sharded-mesh parity, checkpoint
+restart (SURVEY.md §4 gap fix: automated assertions on learning outcomes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wormhole_tpu.data.feed import pad_block_global
+from wormhole_tpu.data.rowblock import RowBlockContainer
+from wormhole_tpu.models.linear import (LinearConfig, LinearLBFGS,
+                                        LinearObjective)
+from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver, init_state
+
+
+class Quadratic:
+    """f(w) = ½ wᵀAw − bᵀw; analytic minimum at A⁻¹b."""
+
+    def __init__(self, a, b):
+        self.a, self.b = jnp.asarray(a), jnp.asarray(b)
+        self.num_features = len(b)
+
+    def calc_grad(self, w):
+        aw = self.a @ w
+        return 0.5 * jnp.dot(w, aw) - jnp.dot(self.b, w), aw - self.b
+
+    def objv(self, w):
+        return 0.5 * jnp.dot(w, self.a @ w) - jnp.dot(self.b, w)
+
+    def directional(self, w, d):
+        return None  # force the full-eval line-search path
+
+
+def test_lbfgs_quadratic(rng):
+    n = 20
+    m = rng.standard_normal((n, n)).astype(np.float32)
+    a = m @ m.T + 0.5 * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    obj = Quadratic(a, b)
+    solver = LBFGSSolver(LBFGSConfig(max_iter=60, epsilon=1e-10), obj)
+    state = solver.run()
+    w_star = np.linalg.solve(a, b)
+    np.testing.assert_allclose(np.asarray(state.w), w_star, atol=2e-2)
+
+
+def make_logreg_batches(rng, n=256, f=32, mb=64, nnz=32, sep=2.0):
+    """Dense rows as padded batches + the (X, y) matrices for scipy."""
+    w_true = rng.standard_normal(f).astype(np.float32)
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    logits = sep * x @ w_true / np.sqrt(f)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    batches = []
+    for lo in range(0, n, mb):
+        cont = RowBlockContainer()
+        for i in range(lo, min(lo + mb, n)):
+            cont.push(float(y[i]), np.arange(f, dtype=np.uint64), x[i])
+        batches.append(pad_block_global(cont.finalize(), mb, nnz))
+    return batches, x, y
+
+
+def scipy_logreg_objv(x, y, reg_l2=0.0, reg_l1=0.0):
+    from scipy.optimize import minimize
+    ypm = 2 * y - 1
+
+    def f(w):
+        m = x @ w
+        v = np.sum(np.logaddexp(0, -ypm * m)) + 0.5 * reg_l2 * w @ w
+        return v + reg_l1 * np.abs(w).sum()
+
+    w0 = np.zeros(x.shape[1])
+    r = minimize(f, w0, method="L-BFGS-B")
+    return r.fun
+
+
+def test_linear_logit_matches_scipy(rng):
+    batches, x, y = make_logreg_batches(rng)
+    app = LinearLBFGS(LinearConfig(loss="logit", reg_l2=1.0, max_iter=80,
+                                   epsilon=1e-9, minibatch_size=64,
+                                   num_features=32, max_nnz=32),
+                      MeshRuntime.create())
+    app.fit(batches)
+    ours = float(app.solver.history[-1])
+    best = scipy_logreg_objv(x, y, reg_l2=1.0)
+    assert ours <= best * 1.001 + 1e-3, (ours, best)
+    metrics = app.evaluate(batches)
+    assert metrics["auc"] > 0.8
+    assert 0 < metrics["logloss"] < 0.7
+
+
+def test_owlqn_l1_sparsity(rng):
+    batches, x, y = make_logreg_batches(rng)
+    app = LinearLBFGS(LinearConfig(loss="logit", reg_l1=5.0, max_iter=80,
+                                   epsilon=1e-9, minibatch_size=64,
+                                   num_features=32, max_nnz=32),
+                      MeshRuntime.create())
+    w = np.asarray(app.fit(batches))
+    nnz = (np.abs(w) > 1e-8).sum()
+    assert nnz < 32, f"OWL-QN produced a dense weight vector (nnz={nnz})"
+    ours = float(app.solver.history[-1])
+    best = scipy_logreg_objv(x, y, reg_l1=5.0)
+    assert ours <= best * 1.05 + 1e-2, (ours, best)
+
+
+def test_linear_sharded_matches_single(rng):
+    batches, _, _ = make_logreg_batches(rng)
+    cfg = dict(loss="logit", reg_l2=0.5, max_iter=20, epsilon=1e-9,
+               minibatch_size=64, num_features=32, max_nnz=32)
+    single = LinearLBFGS(LinearConfig(**cfg), MeshRuntime.create())
+    single.rt.mesh = make_mesh("data:1", jax.devices()[:1])
+    w1 = np.asarray(single.fit(batches))
+
+    multi = LinearLBFGS(LinearConfig(**cfg),
+                        MeshRuntime.create("data:2,model:4"))
+    sharded = [jax.device_put(b, multi._batch_sharding()) for b in batches]
+    w8 = np.asarray(multi.fit(sharded))
+    np.testing.assert_allclose(w8, w1, atol=1e-3)
+
+
+def test_lbfgs_checkpoint_restart(rng, tmp_path):
+    batches, _, _ = make_logreg_batches(rng)
+    cfg = dict(loss="logit", reg_l2=1.0, max_iter=12, epsilon=0.0,
+               minibatch_size=64, num_features=32, max_nnz=32)
+    full = LinearLBFGS(LinearConfig(**cfg), MeshRuntime.create())
+    w_full = np.asarray(full.fit(batches))
+
+    ckdir = str(tmp_path / "ck")
+    half = LinearLBFGS(LinearConfig(**cfg, checkpoint_dir=ckdir),
+                       MeshRuntime.create())
+    half.cfg.max_iter = 6
+    half.fit(batches)
+    resumed = LinearLBFGS(LinearConfig(**cfg, checkpoint_dir=ckdir),
+                          MeshRuntime.create())
+    w_res = np.asarray(resumed.fit(batches))
+    np.testing.assert_allclose(w_res, w_full, atol=5e-4)
+
+
+def test_linear_model_save_load(rng, tmp_path):
+    batches, _, _ = make_logreg_batches(rng)
+    app = LinearLBFGS(LinearConfig(loss="logit", reg_l2=1.0, max_iter=10,
+                                   minibatch_size=64, num_features=32,
+                                   max_nnz=32), MeshRuntime.create())
+    app.fit(batches)
+    path = str(tmp_path / "model.bin")
+    app.save_model(path)
+    app2 = LinearLBFGS(LinearConfig(), MeshRuntime.create())
+    w2 = app2.load_model(path)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(app.w))

@@ -140,13 +140,17 @@ class KMeans:
             part, nparts = self.rt.local_part()
         mb = self.cfg.minibatch_size
         it = MinibatchIter(uri, part, nparts, data_format, mb)
-        batches, fdim = [], self.cfg.num_features
+        batches = []
         blocks = list(it)
+        local_max = max((b.max_index() for b in blocks), default=0)
         if not self.cfg.num_features:
-            local_max = max((b.max_index() for b in blocks), default=0)
-            fdim = int(allreduce_tree(np.int64(local_max + 1),
-                                      self.rt.mesh, "max"))
-            self.cfg.num_features = fdim
+            self.cfg.num_features = int(allreduce_tree(
+                np.int64(local_max + 1), self.rt.mesh, "max"))
+        elif local_max >= self.cfg.num_features:
+            # out-of-range ids would be silently clamped/dropped inside jit
+            raise ValueError(
+                f"feature id {local_max} >= num_features "
+                f"{self.cfg.num_features}")
         nnz = self.cfg.max_nnz or max(
             (next_bucket(b.max_row_nnz(), 8) for b in blocks), default=8)
         self.cfg.max_nnz = nnz
@@ -157,18 +161,12 @@ class KMeans:
         return batches
 
     def _batch_sharding(self):
+        """One sharding for every leaf: batch dim over ``data``, trailing
+        dims replicated (a short PartitionSpec covers all ranks)."""
         mesh = self.rt.mesh
         if DATA_AXIS not in mesh.axis_names or self.rt.data_axis_size == 1:
             return None
-
-        def spec(x):
-            return NamedSharding(
-                mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
-        return jax.tree.map(
-            spec, DenseBatch(cols=np.zeros((1, 1), np.int32),
-                             vals=np.zeros((1, 1), np.float32),
-                             labels=np.zeros(1, np.float32),
-                             row_mask=np.zeros(1, np.float32)))
+        return NamedSharding(mesh, P(DATA_AXIS))
 
     # -- init ---------------------------------------------------------------
 
@@ -218,16 +216,27 @@ class KMeans:
                  jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
         for batch in batches:
             stats = _accumulate_jit(stats, cent_t, batch)
-        sums, counts, objv, seen = jax.tree.map(np.asarray, stats)
-        # cross-host Sum-allreduce (rabit::Allreduce<Sum>, kmeans.cc:249)
-        sums, counts, objv, seen = allreduce_tree(
-            (sums, counts, objv, seen), self.rt.mesh, "sum")
-        new_state = _recompute(state, jnp.asarray(sums), jnp.asarray(counts))
+        sums, counts, objv, seen = stats
+        if jax.process_count() > 1:
+            # cross-host Sum-allreduce (rabit::Allreduce<Sum>, kmeans.cc:249)
+            sums, counts, objv, seen = jax.tree.map(
+                jnp.asarray,
+                allreduce_tree(jax.tree.map(np.asarray, stats),
+                               self.rt.mesh, "sum"))
+        new_state = _recompute(state, sums, counts)
         mean_objv = float(objv) / max(float(seen), 1.0)
         return new_state, mean_objv
 
     def fit(self, batches: List[DenseBatch]) -> KMeansState:
-        template = self.state or self.init_centroids(batches)
+        if self.state is None and self.ckpt.latest_version():
+            # restart path: a zeros template carries the pytree structure;
+            # don't waste the init scan the checkpoint exists to skip
+            template = KMeansState(
+                centroids=np.zeros((self.cfg.num_clusters,
+                                    self.cfg.num_features), np.float32),
+                version=np.zeros((), np.int32))
+        else:
+            template = self.state or self.init_centroids(batches)
         version, state = self.ckpt.load(template)
         if version:
             log.info("restart from version=%d", version)

@@ -1,0 +1,288 @@
+"""Distributed vector-free L-BFGS with OWL-QN, TPU-native.
+
+Rebuild of the reference VL-BFGS solver (``learn/solver/lbfgs.h:117-645``):
+the two-loop recursion runs on the (2m+1)² Gram matrix of dot products among
+{s-history, y-history, gradient}, so each node only ever touches its slice of
+the long vectors — on TPU the long (F,) vectors are sharded over the
+``model`` mesh axis and the Gram matrix ``B Bᵀ`` is ONE (2m+1, F)×(F, 2m+1)
+matmul whose F-contraction XLA turns into a psum over the mesh: exactly the
+reference's ``Allreduce<Sum>(dots)`` (lbfgs.h:246-252) but fused and on the
+MXU.
+
+Differences from the reference worth knowing:
+- History storage is two fixed (m, F) rings updated by roll+set (jit-stable
+  shapes) instead of the byte-serialized ``HistoryArray`` (lbfgs.h:557-645).
+- The backtracking line search (lbfgs.h:321-355) evaluates trial points via a
+  *directional margin cache* when the objective supports it: with
+  ``mw = X·w`` and ``md = X·d`` cached, objv(w+αd) is elementwise in α — one
+  data pass per *iteration* instead of one per *trial* (the reference's
+  hottest loop, SURVEY.md §3.2). With L1 (OWL-QN orthant projection) the
+  trial point is not linear in α, so it falls back to full evaluation.
+- OWL-QN (``SetL1Dir/FixDirL1Sign/FixWeightL1Sign``, lbfgs.h:358-400) is the
+  standard pseudo-gradient + orthant-projection formulation, elementwise jnp.
+
+Checkpoint: full solver state (w, rings, objv, version) through the
+versioned Checkpointer — rabit ``LoadCheckPoint/CheckPoint`` (lbfgs.h:120,194).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Optional, Protocol, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.parallel.checkpoint import Checkpointer
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("lbfgs")
+
+
+class Objective(Protocol):
+    """The IObjFunction surface (lbfgs.h:22-52), functional."""
+
+    num_features: int
+
+    def calc_grad(self, w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """→ (objv scalar, grad (F,)) — one data pass."""
+
+    def objv(self, w: jax.Array) -> jax.Array:
+        """→ objv scalar — one data pass."""
+
+    def directional(self, w: jax.Array, d: jax.Array
+                    ) -> Optional[Callable[[float], jax.Array]]:
+        """Optional fast line search: returns objv_at(alpha) after one data
+        pass caching X·w and X·d; None if unsupported."""
+
+
+@dataclass
+class LBFGSConfig:
+    """Solver knobs (reference SetParam surface, lbfgs.h:75-103)."""
+    memory: int = 10            # size_memory
+    max_iter: int = 100
+    min_iter: int = 0
+    reg_l1: float = 0.0
+    c1: float = 1e-4            # Armijo sufficient-decrease
+    backoff: float = 0.5        # alpha *= backoff per failed trial
+    max_linesearch: int = 30
+    init_alpha: float = 1.0
+    epsilon: float = 1e-5       # relative objv-decrease stop tolerance
+    checkpoint_dir: str = ""
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class LBFGSState:
+    """Checkpointable solver state (reference GlobalState, lbfgs.h:464-555)."""
+    w: jax.Array                 # (F,)
+    S: jax.Array                 # (m, F) s-history ring, newest at m-1
+    Y: jax.Array                 # (m, F) y-history ring, newest at m-1
+    nh: jax.Array                # int32 scalar: valid history entries
+    objv: jax.Array              # f32 scalar: objective at w (incl. L1)
+    version: jax.Array = field(default_factory=lambda: np.zeros((), np.int32))
+
+
+def init_state(w0: jax.Array, memory: int) -> LBFGSState:
+    f = w0.shape[0]
+    return LBFGSState(
+        w=jnp.asarray(w0, jnp.float32),
+        S=jnp.zeros((memory, f), jnp.float32),
+        Y=jnp.zeros((memory, f), jnp.float32),
+        nh=jnp.zeros((), jnp.int32),
+        objv=jnp.asarray(jnp.inf, jnp.float32),
+        version=np.zeros((), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# OWL-QN elementwise pieces (lbfgs.h:358-400)
+# ---------------------------------------------------------------------------
+
+def pseudo_gradient(w: jax.Array, g: jax.Array, l1: float) -> jax.Array:
+    """∂(loss + λ1|w|) using the one-sided derivative that points downhill
+    at w=0 (SetL1Dir, lbfgs.h:358-376)."""
+    if l1 == 0.0:
+        return g
+    up, dn = g + l1, g - l1
+    at_zero = jnp.where(up < 0, up, jnp.where(dn > 0, dn, 0.0))
+    return jnp.where(w > 0, up, jnp.where(w < 0, dn, at_zero))
+
+
+def fix_dir_sign(d: jax.Array, pg: jax.Array, l1: float) -> jax.Array:
+    """Constrain the direction to the descent orthant: zero components that
+    point against -pg (FixDirL1Sign, lbfgs.h:378-386)."""
+    if l1 == 0.0:
+        return d
+    return jnp.where(d * pg >= 0, 0.0, d)
+
+
+def project_orthant(w_new: jax.Array, w: jax.Array, pg: jax.Array,
+                    l1: float) -> jax.Array:
+    """Clip the trial point to the orthant of w (sign(-pg) at w=0):
+    components that crossed zero are set to 0 (FixWeightL1Sign,
+    lbfgs.h:388-400)."""
+    if l1 == 0.0:
+        return w_new
+    xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
+    return jnp.where(w_new * xi > 0, w_new, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# vector-free two-loop on the Gram matrix (FindChangeDirection,
+# lbfgs.h:226-303)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("memory",))
+def compute_direction(S: jax.Array, Y: jax.Array, nh: jax.Array,
+                      g: jax.Array, *, memory: int) -> jax.Array:
+    """dir = -H·g via two-loop recursion entirely in dot-product space.
+
+    Basis B = [S; Y; g] (2m+1, F); D = B Bᵀ is the one cross-shard reduction
+    (the reference's tiny dots Allreduce). The recursion unrolls over the
+    static ring size with validity masks (slot j holds real history iff
+    j >= m - nh; newest at m-1)."""
+    m = memory
+    B = jnp.concatenate([S, Y, g[None, :]], axis=0)      # (2m+1, F)
+    D = B @ B.T                                          # psum over model axis
+    delta = jnp.zeros(2 * m + 1, D.dtype).at[2 * m].set(-1.0)
+
+    def rho_of(j):
+        sy = D[j, m + j]
+        return jnp.where(jnp.abs(sy) > 1e-20, 1.0 / sy, 0.0)
+
+    alphas = [jnp.zeros((), D.dtype)] * m
+    # newest → oldest
+    for k in range(m):
+        j = m - 1 - k
+        valid = (k < nh).astype(D.dtype)
+        a = rho_of(j) * jnp.dot(delta, D[j]) * valid
+        delta = delta.at[m + j].add(-a)
+        alphas[j] = a
+    # initial Hessian scale H0 = s·y / y·y of the newest pair
+    sy, yy = D[m - 1, 2 * m - 1], D[2 * m - 1, 2 * m - 1]
+    h0 = jnp.where((nh > 0) & (yy > 1e-20), sy / yy, 1.0)
+    delta = delta * h0
+    # oldest → newest
+    for k in reversed(range(m)):
+        j = m - 1 - k
+        valid = (k < nh).astype(D.dtype)
+        b = rho_of(j) * jnp.dot(delta, D[m + j]) * valid
+        delta = delta.at[j].add((alphas[j] - b) * valid)
+    return delta @ B                                     # (F,)
+
+
+@jax.jit
+def push_history(S: jax.Array, Y: jax.Array, nh: jax.Array,
+                 s: jax.Array, y: jax.Array):
+    """Ring update; skip pairs with non-positive curvature (keeps Hᵏ PD)."""
+    sy = jnp.dot(s, y)
+    ok = sy > 1e-10 * jnp.dot(y, y)
+
+    def do(args):
+        S, Y, nh = args
+        S = jnp.roll(S, -1, axis=0).at[-1].set(s)
+        Y = jnp.roll(Y, -1, axis=0).at[-1].set(y)
+        return S, Y, jnp.minimum(nh + 1, S.shape[0])
+
+    return jax.lax.cond(ok, do, lambda a: a, (S, Y, nh))
+
+
+# ---------------------------------------------------------------------------
+# solver driver
+# ---------------------------------------------------------------------------
+
+class LBFGSSolver:
+    """Host loop (reference LBFGSSolver::Run, lbfgs.h:198-212)."""
+
+    def __init__(self, cfg: LBFGSConfig, obj: Objective):
+        self.cfg = cfg
+        self.obj = obj
+        self.ckpt = Checkpointer(cfg.checkpoint_dir)
+        self.history: list = []  # objv per iteration
+
+    def _full_objv(self, w: jax.Array) -> jax.Array:
+        v = self.obj.objv(w)
+        if self.cfg.reg_l1:
+            v = v + self.cfg.reg_l1 * jnp.sum(jnp.abs(w))
+        return v
+
+    def _line_search(self, state: LBFGSState, d: jax.Array, pg: jax.Array,
+                     gTd: float):
+        """Backtracking Armijo (BacktrackLineSearch, lbfgs.h:321-355).
+        Returns (w_new, objv_new, alpha) or (None, None, 0) on failure."""
+        cfg = self.cfg
+        alpha = cfg.init_alpha
+        f0 = float(state.objv)
+        objv_at = None
+        if cfg.reg_l1 == 0.0:
+            objv_at = self.obj.directional(state.w, d)
+        for _ in range(cfg.max_linesearch):
+            if objv_at is not None:
+                f_new = float(objv_at(alpha))
+                w_new = None  # materialized lazily on accept
+            else:
+                w_new = project_orthant(state.w + alpha * d, state.w, pg,
+                                        cfg.reg_l1)
+                f_new = float(self._full_objv(w_new))
+            if f_new <= f0 + cfg.c1 * alpha * gTd and np.isfinite(f_new):
+                if w_new is None:
+                    w_new = state.w + alpha * d
+                return w_new, f_new, alpha
+            alpha *= cfg.backoff
+        return None, None, 0.0
+
+    def run(self, w0: Optional[jax.Array] = None) -> LBFGSState:
+        cfg = self.cfg
+        template = init_state(
+            w0 if w0 is not None
+            else jnp.zeros(self.obj.num_features, jnp.float32), cfg.memory)
+        version, state = self.ckpt.load(template)
+
+        objv, g = self.obj.calc_grad(state.w)
+        if cfg.reg_l1:
+            objv = objv + cfg.reg_l1 * jnp.sum(jnp.abs(state.w))
+        state = LBFGSState(w=state.w, S=state.S, Y=state.Y, nh=state.nh,
+                           objv=jnp.asarray(objv), version=state.version)
+
+        for it in range(version, cfg.max_iter):
+            pg = pseudo_gradient(state.w, g, cfg.reg_l1)
+            d = compute_direction(state.S, state.Y, state.nh, pg,
+                                  memory=cfg.memory)
+            d = fix_dir_sign(d, pg, cfg.reg_l1)
+            gTd = float(jnp.dot(pg, d))
+            if gTd >= 0:  # not a descent direction: restart from steepest
+                log.info("iter %d: non-descent dir (gTd=%.3g), resetting "
+                         "history", it, gTd)
+                state = LBFGSState(w=state.w, S=jnp.zeros_like(state.S),
+                                   Y=jnp.zeros_like(state.Y),
+                                   nh=jnp.zeros((), jnp.int32),
+                                   objv=state.objv, version=state.version)
+                d = -pg
+                gTd = float(jnp.dot(pg, d))
+            w_new, f_new, alpha = self._line_search(state, d, pg, gTd)
+            if w_new is None:
+                log.info("iter %d: line search failed, stopping", it)
+                break
+            f_old = float(state.objv)
+            new_objv, g_new = self.obj.calc_grad(w_new)
+            if cfg.reg_l1:
+                new_objv = new_objv + cfg.reg_l1 * jnp.sum(jnp.abs(w_new))
+            S, Y, nh = push_history(state.S, state.Y, state.nh,
+                                    w_new - state.w, g_new - g)
+            state = LBFGSState(w=w_new, S=S, Y=Y, nh=nh,
+                               objv=jnp.asarray(new_objv),
+                               version=state.version + 1)
+            g = g_new
+            self.history.append(float(new_objv))
+            log.info("iter %d: objv=%.6f alpha=%.3g", it, float(new_objv),
+                     alpha)
+            self.ckpt.save(it + 1, state)
+            rel = abs(f_old - float(new_objv)) / max(abs(float(new_objv)),
+                                                     1e-12)
+            if it + 1 >= cfg.min_iter and rel < cfg.epsilon:
+                log.info("converged: relative decrease %.3g < %.3g", rel,
+                         cfg.epsilon)
+                break
+        return state

@@ -1,0 +1,88 @@
+"""Workload pool: assignment, failure re-queue, straggler re-execution
+(reference workload_pool.h semantics)."""
+
+import numpy as np
+
+from wormhole_tpu.sched.workload_pool import WorkloadPool, Workload, TRAIN
+
+
+def make_files(tmp_path, n=3):
+    for i in range(n):
+        (tmp_path / f"part-{i:02d}.txt").write_text("x\n")
+    return str(tmp_path / "part-.*\\.txt")
+
+
+def test_add_get_finish(tmp_path):
+    pool = WorkloadPool()
+    n = pool.add(make_files(tmp_path, 3), npart=2)
+    assert n == 6
+    seen = []
+    while True:
+        wl = pool.get("w0")
+        if wl is None:
+            break
+        seen.append((wl.file, wl.part))
+        pool.finish(wl.id)
+    assert len(seen) == 6
+    assert len(set(seen)) == 6
+    assert pool.is_finished()
+
+
+def test_regex_matching(tmp_path):
+    (tmp_path / "data-1.txt").write_text("x")
+    (tmp_path / "data-2.txt").write_text("x")
+    (tmp_path / "other.csv").write_text("x")
+    pool = WorkloadPool()
+    assert pool.add(str(tmp_path / "data-\\d\\.txt")) == 2
+
+
+def test_failure_requeue(tmp_path):
+    pool = WorkloadPool()
+    pool.add(make_files(tmp_path, 2), npart=1)
+    wl_a = pool.get("alice")
+    wl_b = pool.get("bob")
+    assert wl_a is not None and wl_b is not None
+    # alice dies: her part goes back to the head of the queue
+    pool.reset("alice")
+    wl_c = pool.get("carol")
+    assert (wl_c.file, wl_c.part) == (wl_a.file, wl_a.part)
+    pool.finish(wl_b.id)
+    pool.finish(wl_c.id)
+    assert pool.is_finished()
+
+
+def test_straggler_reexecution(tmp_path):
+    clock = [0.0]
+    pool = WorkloadPool(straggler_factor=3.0, time_fn=lambda: clock[0])
+    pool.add(make_files(tmp_path, 3), npart=1)
+    # two quick tasks establish the mean duration (1s)
+    for _ in range(2):
+        wl = pool.get("fast")
+        clock[0] += 1.0
+        pool.finish(wl.id)
+    slow = pool.get("slow")
+    clock[0] += 10.0  # way past 3x mean
+    rerun = pool.get("helper")  # queue empty → straggler re-issued
+    assert rerun is not None and rerun.id == slow.id
+    pool.finish(rerun.id)
+    # the original's eventual completion is a no-op
+    pool.finish(slow.id)
+    assert pool.get("fast") is None
+    assert pool.is_finished()
+
+
+def test_finished_part_not_reassigned(tmp_path):
+    clock = [0.0]
+    pool = WorkloadPool(straggler_factor=3.0, time_fn=lambda: clock[0])
+    pool.add(make_files(tmp_path, 1), npart=2)
+    a = pool.get("w")
+    clock[0] += 1.0
+    pool.finish(a.id)
+    b = pool.get("w")
+    clock[0] += 50.0
+    # b is now a straggler; re-queued copy appears
+    c = pool.get("x")
+    assert c.id == b.id
+    pool.finish(b.id)  # original finishes first
+    assert pool.get("y") is None  # the copy must not be handed out again
+    assert pool.is_finished()

@@ -1,0 +1,75 @@
+"""Shared block→device-batch loading for the BSP apps (k-means, linear).
+
+Both apps read their host's input shard (``RowBlockIter::Create(uri, rank,
+world)`` semantics, kmeans.cc:155-160 / linear.cc:229-234), derive the
+global feature dimension via an ``Allreduce<Max>`` when unset
+(linear.cc:110-114), pad every block into fixed shapes, and shard the batch
+dim over the ``data`` mesh axis. One implementation, parameterized.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from wormhole_tpu.data.feed import DenseBatch, next_bucket, pad_block_global
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.parallel.collectives import allreduce_tree
+from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
+
+
+@dataclass
+class LoadedBatches:
+    batches: List[DenseBatch]
+    num_features: int
+    max_nnz: int
+
+
+def dense_batch_sharding(rt: MeshRuntime):
+    """Batch dim over ``data``, trailing dims replicated (a short
+    PartitionSpec covers all leaf ranks); None when unsharded."""
+    if DATA_AXIS not in rt.mesh.axis_names or rt.data_axis_size == 1:
+        return None
+    return NamedSharding(rt.mesh, P(DATA_AXIS))
+
+
+def load_dense_batches(uri: str, rt: MeshRuntime, *,
+                       data_format: str = "libsvm",
+                       minibatch_size: int = 1024,
+                       num_features: int = 0,
+                       max_nnz: int = 0,
+                       feature_multiple: int = 1,
+                       part: Optional[int] = None,
+                       nparts: Optional[int] = None) -> LoadedBatches:
+    """Read part ``rank/world`` of ``uri``, pad, device_put sharded.
+
+    ``feature_multiple`` rounds num_features up (model-axis divisibility for
+    feature-sharded weights); the padded tail never appears in any cols
+    array. Preset ``num_features`` is validated against the data — an
+    out-of-range id would otherwise be silently clamped/dropped inside jit.
+    """
+    if part is None or nparts is None:
+        part, nparts = rt.local_part()
+    blocks = list(MinibatchIter(uri, part, nparts, data_format,
+                                minibatch_size))
+    local_max = max((b.max_index() for b in blocks), default=0)
+    if not num_features:
+        num_features = int(allreduce_tree(np.int64(local_max + 1),
+                                          rt.mesh, "max"))
+    elif local_max >= num_features:
+        raise ValueError(f"feature id {local_max} >= num_features "
+                         f"{num_features}")
+    num_features = -(-num_features // feature_multiple) * feature_multiple
+    if not max_nnz:
+        max_nnz = max((next_bucket(b.max_row_nnz(), 8) for b in blocks),
+                      default=8)
+    sharding = dense_batch_sharding(rt)
+    batches = []
+    for blk in blocks:
+        db = pad_block_global(blk, minibatch_size, max_nnz)
+        batches.append(jax.device_put(db, sharding) if sharding else db)
+    return LoadedBatches(batches, num_features, max_nnz)

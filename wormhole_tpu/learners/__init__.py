@@ -1,0 +1,7 @@
+"""Online learners: the ps-lite replacement (SURVEY.md §7 stage 5).
+
+The reference's worker/server/scheduler processes (``learn/linear/sgd``)
+collapse into: a sharded parameter store (``store.py``), pure per-key update
+rules (``handles.py``), and a host driver with a bounded-staleness dispatch
+pipeline (``async_sgd.py``).
+"""

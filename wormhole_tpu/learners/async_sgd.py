@@ -1,0 +1,197 @@
+"""Online sharded-SGD driver — the flagship app (reference ``async_sgd``).
+
+Rebuild of the three-role ps-lite program (``learn/linear/sgd/async_sgd.h``):
+
+- the SCHEDULER's pass/workload loop (async_sgd.h:245-348) is ``run()`` +
+  the WorkloadPool;
+- the WORKER's minibatch pipeline (async_sgd.h:35-165) is ``process()``:
+  stream → localize → pad → dispatch the fused device step, with the
+  **bounded-staleness window**: at most ``max_delay`` device steps in
+  flight, enforced by blocking on the oldest dispatched step's metrics
+  (the reference's cond-var WaitMinibatch, async_sgd.h:81,119-142 — here
+  JAX's async dispatch IS the pipeline, and ``block_until_ready``
+  bookkeeping is the gate);
+- the SERVER's handle application (async_sgd.h:171-239) is fused into the
+  same jitted step (learners/store.py).
+
+Validation passes use an unbounded window (eval "workloads use effectively
+infinite delay", async_sgd.h:60-61). Progress rows print every ``disp_itv``
+seconds in the reference's format; ``max_objv`` is the divergence kill
+switch (async_sgd.h:316-319).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from wormhole_tpu.data.feed import batch_max_nnz, next_bucket, pad_to_batch
+from wormhole_tpu.data.localizer import Localizer
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.learners.handles import LearnRate, create_handle
+from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.parallel.mesh import MeshRuntime
+from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
+from wormhole_tpu.utils.config import Config
+from wormhole_tpu.utils.logging import get_logger
+from wormhole_tpu.utils.progress import Progress
+
+log = get_logger("async_sgd")
+
+
+class DivergedError(RuntimeError):
+    pass
+
+
+class AsyncSGD:
+    """Scheduler+worker in one host process per TPU host."""
+
+    def __init__(self, cfg: Config, runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.rt = runtime or MeshRuntime.create(cfg.mesh_shape)
+        lam = list(cfg.lambda_) + [0.0, 0.0]
+        penalty = L1L2(lambda1=lam[0], lambda2=lam[1])
+        handle = create_handle(cfg.algo.value, penalty,
+                               LearnRate(cfg.lr_eta, cfg.lr_beta))
+        self.store = ShardedStore(
+            StoreConfig(num_buckets=cfg.num_buckets, loss=cfg.loss.value,
+                        fixed_bytes=cfg.fixed_bytes,
+                        lr_theta=cfg.lr_theta),
+            handle, self.rt)
+        self.localizer = Localizer(num_buckets=cfg.num_buckets,
+                                   tail_freq=cfg.tail_feature_freq)
+        self.pool = WorkloadPool()
+        self.start_time = time.time()
+        self._last_disp = 0.0
+        self._prev_num_ex = 0
+        self.progress = Progress()
+        self._max_nnz = cfg.max_nnz
+
+    # -- worker data path ---------------------------------------------------
+
+    def _batches(self, file: str, part: int, nparts: int):
+        """stream → localize → pad, with shape bucketing for XLA."""
+        cfg = self.cfg
+        reader = MinibatchIter(file, part, nparts, cfg.data_format,
+                               cfg.minibatch)
+        for blk in reader:
+            loc = self.localizer.localize(blk)
+            # per-batch nnz bucket, monotone so shapes don't thrash; a denser
+            # later batch grows the bucket (one recompile) instead of being
+            # silently truncated
+            if not cfg.max_nnz:
+                self._max_nnz = max(self._max_nnz, batch_max_nnz(blk))
+            kpad = next_bucket(len(loc.uniq_keys), 64)
+            yield pad_to_batch(loc, cfg.minibatch, self._max_nnz, kpad)
+
+    def process(self, file: str, part: int, nparts: int,
+                kind: str = TRAIN) -> Progress:
+        """One workload part (AsyncSGDWorker::Process, async_sgd.h:57-127)."""
+        cfg = self.cfg
+        max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
+        inflight: deque = deque()
+        local = Progress()
+
+        def harvest(metrics) -> None:
+            objv, num_ex, a, acc, *_ = [float(np.asarray(m))
+                                        for m in metrics[:4]] + [0]
+            local.objv += objv
+            local.num_ex += int(num_ex)
+            local.count += 1
+            local.auc += a
+            local.acc += acc
+            self._display(local)
+
+        for batch in self._batches(file, part, nparts):
+            while len(inflight) > max_delay:       # WaitMinibatch(max_delay)
+                harvest(jax.block_until_ready(inflight.popleft()))
+            if kind == TRAIN:
+                m = self.store.train_step(batch, tau=float(len(inflight)))
+            else:
+                m = self.store.eval_step(batch)[:4]
+            inflight.append(m)
+        while inflight:                            # WaitMinibatch(0)
+            harvest(jax.block_until_ready(inflight.popleft()))
+        return local
+
+    # -- scheduler loop -----------------------------------------------------
+
+    def run(self) -> Progress:
+        """Pass/workload loop (AsyncSGDScheduler::Run, async_sgd.h:294-348)."""
+        cfg = self.cfg
+        worker = f"proc{self.rt.rank}"
+        print(Progress.HEADER)
+        for data_pass in range(cfg.max_data_pass):
+            self.pool.clear()
+            self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
+            while True:
+                wl = self.pool.get(worker)
+                if wl is None:
+                    break
+                prog = self.process(wl.file, wl.part, wl.nparts, wl.kind)
+                self.progress.merge(prog)
+                self.pool.finish(wl.id)
+                self._check_divergence()
+            if cfg.val_data:
+                vp = self._run_eval(cfg.val_data)
+                n = max(vp.num_ex, 1)
+                log.info("pass %d validation: objv=%.6f auc=%.6f acc=%.6f",
+                         data_pass, vp.objv / n, vp.auc / max(vp.count, 1),
+                         vp.acc / max(vp.count, 1))
+        if cfg.model_out:
+            self.store.save_model(cfg.model_out, self.rt.rank)
+        return self.progress
+
+    def _run_eval(self, pattern: str) -> Progress:
+        pool = WorkloadPool()
+        pool.add(pattern, self.cfg.num_parts_per_file, VAL)
+        total = Progress()
+        while True:
+            wl = pool.get("eval")
+            if wl is None:
+                break
+            total.merge(self.process(wl.file, wl.part, wl.nparts, VAL))
+            pool.finish(wl.id)
+        return total
+
+    # -- observability ------------------------------------------------------
+
+    def _display(self, local: Progress) -> None:
+        now = time.time()
+        if now - self._last_disp < self.cfg.disp_itv:
+            return
+        self._last_disp = now
+        snap = Progress(self.progress.fvec + local.fvec,
+                        self.progress.ivec + local.ivec)
+        snap.nnz_w = self.store.nnz_weight()
+        print(snap.print_row(now - self.start_time, self._prev_num_ex))
+        self._prev_num_ex = snap.num_ex
+
+    def _check_divergence(self) -> None:
+        cfg = self.cfg
+        n = max(self.progress.num_ex, 1)
+        if cfg.max_objv and self.progress.objv / n > cfg.max_objv:
+            raise DivergedError(
+                f"objv {self.progress.objv / n:.4f} > max_objv "
+                f"{cfg.max_objv} (async_sgd.h:316-319 kill switch)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m wormhole_tpu.learners.async_sgd conf key=val ...``"""
+    import sys
+    from wormhole_tpu.utils.config import load_config
+    args = list(sys.argv[1:] if argv is None else argv)
+    conf = args.pop(0) if args and "=" not in args[0] else None
+    cfg = load_config(conf, args)
+    app = AsyncSGD(cfg)
+    app.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

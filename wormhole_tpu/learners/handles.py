@@ -1,0 +1,172 @@
+"""Per-key online update rules — the "server handles", functional.
+
+Rebuild of ``learn/linear/sgd/sgd_server_handle.h`` (SGD / AdaGrad / FTRL,
+each a lock-free per-key struct the KVServer applies under its receive
+thread) and the experimental delay-tolerant variants
+(``learn/linear/sgd/delay_tol_handle.h``). Here each handle is a *pure
+function* over a ``(k, val_len)`` slot matrix — vmapped/vectorized over
+keys, jitted into the train step, sharded over the ``model`` mesh axis by
+the store. Slot layouts match the reference exactly:
+
+- SGD      val = [w]           (sgd_server_handle.h:43-68)
+- AdaGrad  val = [w, √Σg²]     (sgd_server_handle.h:80-99)
+- FTRL     val = [w, z, √Σg²]  (sgd_server_handle.h:111-141)
+- DT-SGD / DT-AdaGrad: learning-rate denominator inflated by the pull→push
+  staleness τ (delay_tol_handle.h:141-194)
+- DT2-AdaGrad: val = [w, √Σg², g_bak]; corrects the accumulator with the
+  cross-term 2·g·g_bak of the gradient remembered at pull time
+  (delay_tol_handle.h:70-111)
+
+All updates end in the L1L2 proximal op (penalty.h:36-41); nnz/|Δw|² deltas
+for the Progress chain are returned alongside.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from wormhole_tpu.ops.penalty import L1L2
+
+
+@dataclass(frozen=True)
+class LearnRate:
+    """eta_t = alpha / (beta + √t-ish) (config.proto lr_eta/lr_beta)."""
+    alpha: float = 0.1
+    beta: float = 1.0
+
+
+@dataclass(frozen=True)
+class Handle:
+    """Base: subclasses define val_len and push(); pull is always slot 0."""
+
+    penalty: L1L2 = L1L2()
+    lr: LearnRate = LearnRate()
+
+    val_len: int = 1
+
+    def init(self, num_keys: int) -> jax.Array:
+        return jnp.zeros((num_keys, self.val_len), jnp.float32)
+
+    def weights(self, slots: jax.Array) -> jax.Array:
+        """Pull: slot 0 is always w (set_sync_val_len(1) semantics —
+        servers store val_len values, sync only w, async_sgd.h:213-217)."""
+        return slots[..., 0]
+
+    def push(self, slots: jax.Array, grad: jax.Array, t: jax.Array,
+             tau: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class SGDHandle(Handle):
+    """w ← prox(w/η − g) with η = α/(β+√t) (sgd_server_handle.h:43-68)."""
+
+    val_len: int = 1
+
+    def push(self, slots, grad, t, tau):
+        w = slots[..., 0]
+        eta = self.lr.alpha / (self.lr.beta + jnp.sqrt(t))
+        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
+        return w_new[..., None]
+
+
+@dataclass(frozen=True)
+class AdaGradHandle(Handle):
+    """Per-key curvature: cg ← √(cg²+g²); η = α/(β+cg)
+    (sgd_server_handle.h:80-99)."""
+
+    val_len: int = 2
+
+    def push(self, slots, grad, t, tau):
+        w, cg = slots[..., 0], slots[..., 1]
+        cg_new = jnp.sqrt(cg * cg + grad * grad)
+        eta = self.lr.alpha / (self.lr.beta + cg_new)
+        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
+        return jnp.stack([w_new, cg_new], axis=-1)
+
+
+@dataclass(frozen=True)
+class FTRLHandle(Handle):
+    """FTRL-proximal (sgd_server_handle.h:111-141): z accumulates g − σ·w,
+    w = prox(−z) with curvature (β+cg)/α. The −z sign matches the reference
+    passing −z into L1L2::Solve (line 135)."""
+
+    val_len: int = 3
+
+    def push(self, slots, grad, t, tau):
+        w, z, cg = slots[..., 0], slots[..., 1], slots[..., 2]
+        cg_new = jnp.sqrt(cg * cg + grad * grad)
+        sigma = (cg_new - cg) / self.lr.alpha
+        z_new = z + grad - sigma * w
+        w_new = self.penalty.solve(
+            -z_new, (self.lr.beta + cg_new) / self.lr.alpha)
+        return jnp.stack([w_new, z_new, cg_new], axis=-1)
+
+
+@dataclass(frozen=True)
+class DTSGDHandle(Handle):
+    """Staleness-inflated SGD: η = α/(β+√t+τ) (delay_tol_handle.h:141-166,
+    lr_theta weighting folded into tau by the caller)."""
+
+    val_len: int = 1
+
+    def push(self, slots, grad, t, tau):
+        w = slots[..., 0]
+        eta = self.lr.alpha / (self.lr.beta + jnp.sqrt(t) + tau)
+        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
+        return w_new[..., None]
+
+
+@dataclass(frozen=True)
+class DTAdaGradHandle(Handle):
+    """AdaGrad with τ added to the denominator (delay_tol_handle.h:168-194)."""
+
+    val_len: int = 2
+
+    def push(self, slots, grad, t, tau):
+        w, cg = slots[..., 0], slots[..., 1]
+        cg_new = jnp.sqrt(cg * cg + grad * grad)
+        eta = self.lr.alpha / (self.lr.beta + cg_new + tau)
+        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
+        return jnp.stack([w_new, cg_new], axis=-1)
+
+
+@dataclass(frozen=True)
+class DT2AdaGradHandle(Handle):
+    """Accumulator cross-term correction (delay_tol_handle.h:70-111): the
+    gradient g_bak remembered from the previous push of the same key set
+    corrects cg² by 2·g·g_bak, compensating what the stale pull missed."""
+
+    val_len: int = 3
+
+    def push(self, slots, grad, t, tau):
+        w, cg, g_bak = slots[..., 0], slots[..., 1], slots[..., 2]
+        cg2 = jnp.maximum(cg * cg + grad * grad + 2.0 * grad * g_bak, 0.0)
+        cg_new = jnp.sqrt(cg2)
+        eta = self.lr.alpha / (self.lr.beta + cg_new)
+        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
+        return jnp.stack([w_new, cg_new, grad], axis=-1)
+
+
+_HANDLES = {
+    "sgd": SGDHandle,
+    "adagrad": AdaGradHandle,
+    "ftrl": FTRLHandle,
+    "dt_sgd": DTSGDHandle,
+    "dt_adagrad": DTAdaGradHandle,
+    "dt2_adagrad": DT2AdaGradHandle,
+}
+
+
+def create_handle(algo: str, penalty: L1L2 = L1L2(),
+                  lr: LearnRate = LearnRate()) -> Handle:
+    """Runtime handle dispatch (AsyncSGDServer::InitHandle,
+    async_sgd.h:189-231)."""
+    key = algo.lower() if isinstance(algo, str) else algo.value
+    if key not in _HANDLES:
+        raise ValueError(f"unknown algo {algo!r}; have {sorted(_HANDLES)}")
+    return _HANDLES[key](penalty=penalty, lr=lr)

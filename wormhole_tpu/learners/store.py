@@ -1,0 +1,175 @@
+"""Sharded parameter store: the KVWorker/KVServer replacement.
+
+The reference shards the model by key range over server processes and moves
+weights/gradients over ZeroMQ (``ps-lite`` ZPush/ZPull, async_sgd.h:84-117).
+Here the model is ONE ``(num_buckets, val_len)`` device array sharded over
+the ``model`` mesh axis; a minibatch's "pull" is a gather of its unique
+bucket rows, the "push" a scatter-add of per-key update deltas — both inside
+the same jitted train step, so XLA turns the key exchange into ICI
+collectives instead of RPC. Keys are hashed into buckets upstream
+(Localizer ``num_buckets`` = the FLAGS_max_key hash kernel; collisions are
+accepted by design, localizer.h:88-96).
+
+The scatter applies ``new_rows − old_rows`` (a delta add) rather than
+writing rows: padded keys carry mask 0 → delta 0, so they are no-ops even
+though they alias bucket 0; real keys are unique per batch by construction.
+
+Fixed-point gradient quantization (the FIXING_FLOAT ps-lite filter,
+async_sgd.h:144-154) is available for the cross-shard hop: with
+``fixed_bytes=1`` gradients quantize to int8 around a per-batch scale before
+the scatter, halving-to-quartering the collective bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.learners.handles import Handle
+from wormhole_tpu.ops.loss import create_loss
+from wormhole_tpu.ops.metrics import accuracy, auc
+from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+
+
+def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
+    """Symmetric fixed-point round-trip (FIXING_FLOAT filter semantics:
+    lossy fixed-byte compression of values in transit)."""
+    scale = jnp.max(jnp.abs(g)) + 1e-30
+    levels = float(2 ** (bits - 1) - 1)
+    q = jnp.round(g / scale * levels)
+    return q * (scale / levels)
+
+
+@dataclass
+class StoreConfig:
+    num_buckets: int = 1 << 20
+    loss: str = "logit"
+    fixed_bytes: int = 0      # 0 = exact; 1 = int8-style quantized grads
+    lr_theta: float = 1.0     # staleness weight for DT handles
+
+
+class ShardedStore:
+    """Model state + the fused pull→forward→backward→push step."""
+
+    def __init__(self, cfg: StoreConfig, handle: Handle,
+                 runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.handle = handle
+        self.rt = runtime
+        self.objv_fn, self.dual_fn = create_loss(cfg.loss)
+        slots = handle.init(cfg.num_buckets)
+        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
+                and runtime.model_axis_size > 1:
+            if cfg.num_buckets % runtime.model_axis_size:
+                raise ValueError(
+                    f"num_buckets {cfg.num_buckets} not divisible by model "
+                    f"axis {runtime.model_axis_size}")
+            slots = jax.device_put(
+                slots, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
+        self.slots = slots
+        self._step = self._build_step()
+        self._eval = self._build_eval()
+        self.t = 1  # global update counter (SGD eta schedule)
+
+    # -- jitted programs ----------------------------------------------------
+
+    def _build_step(self):
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+        fixed_bytes = self.cfg.fixed_bytes
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(slots, batch: SparseBatch, t, tau):
+            rows = slots[batch.uniq_keys]                  # pull (gather)
+            w = handle.weights(rows)
+            margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+            objv = objv_fn(margin, batch.labels, batch.row_mask)
+            dual = dual_fn(margin, batch.labels, batch.row_mask)
+            contrib = batch.vals * dual[:, None]
+            grad = jnp.zeros_like(w).at[batch.cols.reshape(-1)].add(
+                contrib.reshape(-1))
+            if fixed_bytes:
+                grad = quantize_dequantize(grad, 8 * fixed_bytes)
+            new_rows = handle.push(rows, grad, t, tau)
+            delta = (new_rows - rows) * batch.key_mask[:, None]
+            slots = slots.at[batch.uniq_keys].add(delta)   # push (scatter)
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
+            return slots, (objv, num_ex, a, acc, wdelta2)
+
+        return step
+
+    def _build_eval(self):
+        handle, objv_fn = self.handle, self.objv_fn
+
+        @jax.jit
+        def ev(slots, batch: SparseBatch):
+            w = handle.weights(slots[batch.uniq_keys])
+            margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+            objv = objv_fn(margin, batch.labels, batch.row_mask)
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            return objv, num_ex, a, acc, margin
+
+        return ev
+
+    # -- the ZPush/ZPull surface --------------------------------------------
+
+    def train_step(self, batch: SparseBatch, tau: float = 0.0):
+        """Dispatch one fused step; returns the (async) metrics tuple."""
+        self.slots, metrics = self._step(
+            self.slots, batch, jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def eval_step(self, batch: SparseBatch):
+        return self._eval(self.slots, batch)
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Debug/oracle surface: weights for explicit bucket ids."""
+        return np.asarray(self.handle.weights(self.slots[jnp.asarray(keys)]))
+
+    def nnz_weight(self) -> int:
+        return int(jnp.sum(self.handle.weights(self.slots) != 0))
+
+    # -- model IO (per-shard text dump, guide/conf.md:25-27) ----------------
+
+    def save_model(self, path: str, rank: Optional[int] = None) -> None:
+        """Write nonzero (bucket, weight) pairs as text — the reference's
+        per-server ``${model_out}_${server_id}`` shards; here one file per
+        host (process)."""
+        from wormhole_tpu.data.stream import open_stream
+        if rank is None:
+            rank = jax.process_index()
+        w = np.asarray(self.handle.weights(self.slots))
+        nz = np.nonzero(w)[0]
+        with open_stream(f"{path}_{rank}" if rank is not None else path,
+                         "w") as f:
+            for i in nz:
+                f.write(f"{i}\t{w[i]:.6g}\n")
+
+    def load_model(self, path: str) -> None:
+        from wormhole_tpu.data.stream import open_stream
+        with open_stream(path, "r") as f:
+            text = f.read()
+        if isinstance(text, bytes):
+            text = text.decode()
+        w = np.zeros(self.cfg.num_buckets, np.float32)
+        for ln in text.splitlines():
+            if ln.strip():
+                k, v = ln.split()
+                w[int(k)] = float(v)
+        slots = np.array(self.slots)  # copy: device buffers are read-only
+        slots[:, 0] = w
+        self.slots = jax.device_put(jnp.asarray(slots),
+                                    self.slots.sharding)

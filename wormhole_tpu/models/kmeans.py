@@ -18,21 +18,17 @@ lazy-prepare fault-tolerance hook survives as the versioned Checkpointer.
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Callable, Iterable, Iterator, List, Optional
+from typing import Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from wormhole_tpu.data.feed import DenseBatch, next_bucket, pad_block_global
-from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.feed import DenseBatch
 from wormhole_tpu.parallel.checkpoint import Checkpointer
 from wormhole_tpu.parallel.collectives import allreduce_tree
-from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
+from wormhole_tpu.parallel.mesh import MeshRuntime
 from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("kmeans")
@@ -136,37 +132,19 @@ class KMeans:
 
         Mirrors ``RowBlockIter::Create(uri, rank, world)`` (kmeans.cc:155-160)
         but keeps the padded batches resident so later passes are free."""
-        if part is None or nparts is None:
-            part, nparts = self.rt.local_part()
-        mb = self.cfg.minibatch_size
-        it = MinibatchIter(uri, part, nparts, data_format, mb)
-        batches = []
-        blocks = list(it)
-        local_max = max((b.max_index() for b in blocks), default=0)
-        if not self.cfg.num_features:
-            self.cfg.num_features = int(allreduce_tree(
-                np.int64(local_max + 1), self.rt.mesh, "max"))
-        elif local_max >= self.cfg.num_features:
-            # out-of-range ids would be silently clamped/dropped inside jit
-            raise ValueError(
-                f"feature id {local_max} >= num_features "
-                f"{self.cfg.num_features}")
-        nnz = self.cfg.max_nnz or max(
-            (next_bucket(b.max_row_nnz(), 8) for b in blocks), default=8)
-        self.cfg.max_nnz = nnz
-        sharding = self._batch_sharding()
-        for blk in blocks:
-            db = pad_block_global(blk, mb, nnz)
-            batches.append(jax.device_put(db, sharding))
-        return batches
+        from wormhole_tpu.data.loader import load_dense_batches
+        loaded = load_dense_batches(
+            uri, self.rt, data_format=data_format,
+            minibatch_size=self.cfg.minibatch_size,
+            num_features=self.cfg.num_features, max_nnz=self.cfg.max_nnz,
+            part=part, nparts=nparts)
+        self.cfg.num_features = loaded.num_features
+        self.cfg.max_nnz = loaded.max_nnz
+        return loaded.batches
 
     def _batch_sharding(self):
-        """One sharding for every leaf: batch dim over ``data``, trailing
-        dims replicated (a short PartitionSpec covers all ranks)."""
-        mesh = self.rt.mesh
-        if DATA_AXIS not in mesh.axis_names or self.rt.data_axis_size == 1:
-            return None
-        return NamedSharding(mesh, P(DATA_AXIS))
+        from wormhole_tpu.data.loader import dense_batch_sharding
+        return dense_batch_sharding(self.rt)
 
     # -- init ---------------------------------------------------------------
 

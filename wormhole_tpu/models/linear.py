@@ -25,12 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from wormhole_tpu.data.feed import DenseBatch, next_bucket, pad_block_global
-from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.data.feed import DenseBatch
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc, logloss
 from wormhole_tpu.parallel.collectives import allreduce_tree
-from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshRuntime
+from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
 from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
 from wormhole_tpu.utils.logging import get_logger
 
@@ -63,13 +62,12 @@ def _margin_batch(w, batch: DenseBatch):
 
 
 @partial(jax.jit, static_argnames=("objv_fn",))
-def _objv_at_alpha(alpha, mw, md, labels, masks, reg_l2, ww, wd, dd,
-                   objv_fn):
-    """objv(w + α·d) from cached margins (losses sum over all elements, so
-    the stacked (nbatch, mb) layout needs no reshaping)."""
-    total = objv_fn(mw + alpha * md, labels, masks)
-    return total + 0.5 * reg_l2 * (ww + 2.0 * alpha * wd
-                                   + alpha * alpha * dd)
+def _objv_at_alpha(alpha, mw, md, labels, masks, objv_fn):
+    """Loss(w + α·d) from cached margins (losses sum over all elements, so
+    the stacked (nbatch, mb) layout needs no reshaping). Regularization is
+    added by the caller AFTER the cross-host reduction — the loss is a
+    per-host partial sum, the reg term is global."""
+    return objv_fn(mw + alpha * md, labels, masks)
 
 
 class LinearObjective:
@@ -123,14 +121,17 @@ class LinearObjective:
         md = jnp.stack([_margin_batch(d, b) for b in self.batches])
         labels = jnp.stack([b.labels for b in self.batches])
         masks = jnp.stack([b.row_mask for b in self.batches])
-        ww, wd, dd = jnp.sum(w * w), jnp.dot(w, d), jnp.sum(d * d)
+        ww = float(jnp.sum(w * w))
+        wd = float(jnp.dot(w, d))
+        dd = float(jnp.sum(d * d))
 
         def objv_at(alpha: float):
             v = _objv_at_alpha(jnp.asarray(alpha, jnp.float32), mw, md,
-                               labels, masks,
-                               jnp.asarray(self.reg_l2, jnp.float32),
-                               ww, wd, dd, self.objv_fn)
-            return self._cross_host(np.asarray(v))
+                               labels, masks, self.objv_fn)
+            v = float(self._cross_host(np.asarray(v)))
+            # reg added after the allreduce, same as calc_grad/objv
+            return v + 0.5 * self.reg_l2 * (
+                ww + 2.0 * alpha * wd + alpha * alpha * dd)
 
         return objv_at
 
@@ -164,44 +165,20 @@ class LinearLBFGS:
     def load_batches(self, uri: str, data_format: str = "libsvm",
                      part: Optional[int] = None,
                      nparts: Optional[int] = None) -> List[DenseBatch]:
-        if part is None or nparts is None:
-            part, nparts = self.rt.local_part()
-        mb = self.cfg.minibatch_size
-        blocks = list(MinibatchIter(uri, part, nparts, data_format, mb))
-        local_max = max((b.max_index() for b in blocks), default=0)
-        if not self.cfg.num_features:
-            # Allreduce<Max> of the local max feature id (linear.cc:110-114)
-            self.cfg.num_features = int(allreduce_tree(
-                np.int64(local_max + 1), self.rt.mesh, "max"))
-        elif local_max >= self.cfg.num_features:
-            raise ValueError(
-                f"feature id {local_max} >= num_features "
-                f"{self.cfg.num_features}")
-        self._pad_features()
-        nnz = self.cfg.max_nnz or max(
-            (next_bucket(b.max_row_nnz(), 8) for b in blocks), default=8)
-        self.cfg.max_nnz = nnz
-        sharding = self._batch_sharding()
-        out = []
-        for blk in blocks:
-            db = pad_block_global(blk, mb, nnz)
-            out.append(jax.device_put(db, sharding) if sharding else db)
-        return out
-
-    def _pad_features(self) -> None:
-        """Round F up to a multiple of the model-axis size so (F,) arrays
-        shard evenly; padded tail never appears in any cols array."""
-        ms = self.rt.model_axis_size
-        f = self.cfg.num_features
-        self.cfg.num_features = (f + ms - 1) // ms * ms
+        from wormhole_tpu.data.loader import load_dense_batches
+        loaded = load_dense_batches(
+            uri, self.rt, data_format=data_format,
+            minibatch_size=self.cfg.minibatch_size,
+            num_features=self.cfg.num_features, max_nnz=self.cfg.max_nnz,
+            feature_multiple=self.rt.model_axis_size,  # even (F,) sharding
+            part=part, nparts=nparts)
+        self.cfg.num_features = loaded.num_features
+        self.cfg.max_nnz = loaded.max_nnz
+        return loaded.batches
 
     def _batch_sharding(self):
-        """Batch dim over ``data``, trailing dims replicated (a short
-        PartitionSpec covers all leaf ranks)."""
-        mesh = self.rt.mesh
-        if DATA_AXIS not in mesh.axis_names or self.rt.data_axis_size == 1:
-            return None
-        return NamedSharding(mesh, P(DATA_AXIS))
+        from wormhole_tpu.data.loader import dense_batch_sharding
+        return dense_batch_sharding(self.rt)
 
     def _w_sharding(self):
         mesh = self.rt.mesh

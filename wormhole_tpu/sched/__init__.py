@@ -1,0 +1,1 @@
+"""Scheduling / work distribution / fault tolerance (reference L3)."""

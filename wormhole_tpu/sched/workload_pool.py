@@ -1,0 +1,141 @@
+"""Dynamic workload pool: file-part assignment with failure re-queue and
+straggler re-execution.
+
+Rebuild of ``learn/linear/base/workload_pool.h:36-211``: the scheduler
+matches a file pattern on any registered filesystem, splits every file into
+``npart`` virtual byte-range parts, hands one part to each idle worker,
+re-queues a failed worker's parts (``Reset``, workload_pool.h:111,125-140),
+and re-issues tasks running longer than ``straggler_factor ×`` the mean task
+duration (workload_pool.h:169-190). The reference runs a background killer
+thread; here straggler detection runs inline on each ``get`` when the queue
+has drained — same semantics (a re-queued part may run twice; ``finish`` of
+either copy completes it) without a thread to race against.
+
+Workers are host-side data-feeding loops in the TPU rebuild (one per
+process), so "worker id" is any hashable caller identity.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from wormhole_tpu.data.stream import list_files
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("workload_pool")
+
+TRAIN, VAL = "train", "val"
+
+
+@dataclass
+class Workload:
+    """One assignable unit (proto Workload/File, workload.proto:5-20)."""
+    file: str
+    part: int
+    nparts: int
+    kind: str = TRAIN
+    id: int = -1
+
+
+@dataclass
+class _Assigned:
+    wl: Workload
+    worker: object
+    start: float = field(default_factory=time.monotonic)
+    is_rerun: bool = False
+
+
+class WorkloadPool:
+    def __init__(self, straggler_factor: float = 3.0,
+                 time_fn=time.monotonic) -> None:
+        self.straggler_factor = straggler_factor
+        self._time = time_fn
+        self._queue: List[Workload] = []
+        self._assigned: Dict[int, _Assigned] = {}
+        self._done_ids: set = set()
+        self._durations: List[float] = []
+        self._next_id = 0
+
+    # -- reference surface --------------------------------------------------
+
+    def add(self, pattern: str, npart: int = 1, kind: str = TRAIN) -> int:
+        """Match files, split each into npart parts, enqueue
+        (workload_pool.h:36-81). Returns number of parts added."""
+        files = list_files(pattern)
+        if not files:
+            raise FileNotFoundError(f"no files match {pattern!r}")
+        n = 0
+        for fi in files:
+            for p in range(npart):
+                self._queue.append(Workload(fi.path, p, npart, kind,
+                                            self._next_id))
+                self._next_id += 1
+                n += 1
+        log.info("added %d parts from %d files (%s)", n, len(files), pattern)
+        return n
+
+    def clear(self) -> None:
+        self._queue.clear()
+        self._assigned.clear()
+        self._done_ids.clear()
+
+    def get(self, worker: object) -> Optional[Workload]:
+        """Assign the next part to ``worker``; when the queue is empty,
+        consider re-issuing a straggler (workload_pool.h:98-167,169-190)."""
+        if not self._queue:
+            self._requeue_stragglers()
+        while self._queue:
+            wl = self._queue.pop(0)
+            if wl.id in self._done_ids:
+                continue  # completed by another copy while re-queued
+            self._assigned[wl.id] = _Assigned(wl, worker,
+                                              self._time())
+            return wl
+        return None
+
+    def finish(self, workload_id: int) -> None:
+        """Mark a part done (either copy); record duration for the
+        straggler threshold (workload_pool.h:131-148)."""
+        a = self._assigned.pop(workload_id, None)
+        if a is not None:
+            dur = self._time() - a.start
+            self._durations.append(dur)
+            log.info("finished part %d of %s in %.2fs", a.wl.part,
+                     a.wl.file, dur)
+        self._done_ids.add(workload_id)
+        self._queue = [w for w in self._queue if w.id != workload_id]
+
+    def reset(self, worker: object) -> None:
+        """Node-failure handler: re-queue everything assigned to ``worker``
+        (AddNodeFailureHandler → pool_.Reset, async_sgd.h:248-250)."""
+        dead = [wid for wid, a in self._assigned.items()
+                if a.worker == worker]
+        for wid in dead:
+            a = self._assigned.pop(wid)
+            log.info("re-queue part %d of %s from failed worker %r",
+                     a.wl.part, a.wl.file, worker)
+            self._queue.insert(0, a.wl)
+
+    def is_finished(self) -> bool:
+        return not self._queue and not self._assigned
+
+    def pending(self) -> int:
+        return len(self._queue) + len(self._assigned)
+
+    # -- straggler re-execution ---------------------------------------------
+
+    def _requeue_stragglers(self) -> None:
+        if not self._durations:
+            return  # no baseline yet — can't call anything a straggler
+        mean = sum(self._durations) / len(self._durations)
+        threshold = self.straggler_factor * mean
+        now = self._time()
+        for a in self._assigned.values():
+            if not a.is_rerun and now - a.start > threshold:
+                log.info("straggler: re-queue part %d of %s "
+                         "(running %.1fs > %.1fs)", a.wl.part, a.wl.file,
+                         now - a.start, threshold)
+                a.is_rerun = True
+                self._queue.append(a.wl)

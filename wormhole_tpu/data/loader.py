@@ -71,5 +71,7 @@ def load_dense_batches(uri: str, rt: MeshRuntime, *,
     batches = []
     for blk in blocks:
         db = pad_block_global(blk, minibatch_size, max_nnz)
-        batches.append(jax.device_put(db, sharding) if sharding else db)
+        # device_put even when unsharded: batches stay resident in HBM so
+        # every later pass is free of H2D transfer
+        batches.append(jax.device_put(db, sharding))
     return LoadedBatches(batches, num_features, max_nnz)

@@ -71,6 +71,8 @@ class AsyncSGD:
         self._prev_num_ex = 0
         self.progress = Progress()
         self._max_nnz = cfg.max_nnz
+        self._warned_trunc = False
+        self._last_nnz = 0  # model nnz sampled at pass boundaries only
 
     # -- worker data path ---------------------------------------------------
 
@@ -82,10 +84,17 @@ class AsyncSGD:
         for blk in reader:
             loc = self.localizer.localize(blk)
             # per-batch nnz bucket, monotone so shapes don't thrash; a denser
-            # later batch grows the bucket (one recompile) instead of being
-            # silently truncated
+            # later batch grows the bucket (one recompile) up to the 4096-
+            # entry cap — rows beyond the cap (or beyond a user-set
+            # cfg.max_nnz) are positionally truncated, loudly
             if not cfg.max_nnz:
                 self._max_nnz = max(self._max_nnz, batch_max_nnz(blk))
+            densest = blk.max_row_nnz()
+            if densest > self._max_nnz and not self._warned_trunc:
+                self._warned_trunc = True
+                log.warning(
+                    "row with %d features truncated to max_nnz=%d "
+                    "(set max_nnz to keep more)", densest, self._max_nnz)
             kpad = next_bucket(len(loc.uniq_keys), 64)
             yield pad_to_batch(loc, cfg.minibatch, self._max_nnz, kpad)
 
@@ -98,14 +107,17 @@ class AsyncSGD:
         local = Progress()
 
         def harvest(metrics) -> None:
-            objv, num_ex, a, acc, *_ = [float(np.asarray(m))
-                                        for m in metrics[:4]] + [0]
+            vals = [float(np.asarray(m)) for m in metrics]
+            objv, num_ex, a, acc = vals[:4]
             local.objv += objv
             local.num_ex += int(num_ex)
             local.count += 1
             local.auc += a
             local.acc += acc
-            self._display(local)
+            if len(vals) > 4:
+                local.wdelta2 += vals[4]
+            if kind == TRAIN:  # eval metrics must not pollute train rows
+                self._display(local)
 
         for batch in self._batches(file, part, nparts):
             while len(inflight) > max_delay:       # WaitMinibatch(max_delay)
@@ -136,7 +148,8 @@ class AsyncSGD:
                 prog = self.process(wl.file, wl.part, wl.nparts, wl.kind)
                 self.progress.merge(prog)
                 self.pool.finish(wl.id)
-                self._check_divergence()
+                self._check_divergence(prog)
+            self._last_nnz = self.store.nnz_weight()
             if cfg.val_data:
                 vp = self._run_eval(cfg.val_data)
                 n = max(vp.num_ex, 1)
@@ -168,17 +181,23 @@ class AsyncSGD:
         self._last_disp = now
         snap = Progress(self.progress.fvec + local.fvec,
                         self.progress.ivec + local.ivec)
-        snap.nnz_w = self.store.nnz_weight()
+        # nnz from the last pass boundary: a live nnz_weight() would force a
+        # full-model sync and drain the dispatch pipeline every disp_itv
+        snap.nnz_w = self._last_nnz
         print(snap.print_row(now - self.start_time, self._prev_num_ex))
         self._prev_num_ex = snap.num_ex
 
-    def _check_divergence(self) -> None:
+    def _check_divergence(self, prog: Progress) -> None:
+        """Kill switch on the *freshest* workload part (cumulative averages
+        would dilute late divergence); NaN always counts as diverged."""
         cfg = self.cfg
-        n = max(self.progress.num_ex, 1)
-        if cfg.max_objv and self.progress.objv / n > cfg.max_objv:
+        per_ex = prog.objv / max(prog.num_ex, 1)
+        if np.isnan(per_ex):
+            raise DivergedError("objv is NaN")
+        if cfg.max_objv and per_ex > cfg.max_objv:
             raise DivergedError(
-                f"objv {self.progress.objv / n:.4f} > max_objv "
-                f"{cfg.max_objv} (async_sgd.h:316-319 kill switch)")
+                f"objv {per_ex:.4f} > max_objv {cfg.max_objv} "
+                f"(async_sgd.h:316-319 kill switch)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

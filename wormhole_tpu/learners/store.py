@@ -153,8 +153,7 @@ class ShardedStore:
             rank = jax.process_index()
         w = np.asarray(self.handle.weights(self.slots))
         nz = np.nonzero(w)[0]
-        with open_stream(f"{path}_{rank}" if rank is not None else path,
-                         "w") as f:
+        with open_stream(f"{path}_{rank}", "w") as f:
             for i in nz:
                 f.write(f"{i}\t{w[i]:.6g}\n")
 

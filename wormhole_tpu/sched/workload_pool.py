@@ -90,8 +90,14 @@ class WorkloadPool:
             wl = self._queue.pop(0)
             if wl.id in self._done_ids:
                 continue  # completed by another copy while re-queued
-            self._assigned[wl.id] = _Assigned(wl, worker,
-                                              self._time())
+            existing = self._assigned.get(wl.id)
+            if existing is not None:
+                # a straggler copy: keep the original record (its is_rerun
+                # guard stays set, so the task is never re-issued a 3rd
+                # time, and the original's finish/reset bookkeeping holds)
+                existing.is_rerun = True
+            else:
+                self._assigned[wl.id] = _Assigned(wl, worker, self._time())
             return wl
         return None
 

@@ -29,7 +29,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from wormhole_tpu.data.feed import batch_max_nnz, next_bucket, pad_to_batch
+from wormhole_tpu.data.feed import next_bucket, pad_to_batch
 from wormhole_tpu.data.localizer import Localizer
 from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.learners.handles import LearnRate, create_handle
@@ -87,9 +87,11 @@ class AsyncSGD:
             # later batch grows the bucket (one recompile) up to the 4096-
             # entry cap — rows beyond the cap (or beyond a user-set
             # cfg.max_nnz) are positionally truncated, loudly
-            if not cfg.max_nnz:
-                self._max_nnz = max(self._max_nnz, batch_max_nnz(blk))
             densest = blk.max_row_nnz()
+            if not cfg.max_nnz:
+                self._max_nnz = max(self._max_nnz,
+                                    min(next_bucket(max(densest, 1), 8),
+                                        4096))
             if densest > self._max_nnz and not self._warned_trunc:
                 self._warned_trunc = True
                 log.warning(

@@ -1,0 +1,391 @@
+"""Histogram gradient-boosted decision trees, TPU-native.
+
+Rebuild of the wormhole xgboost integration's capability
+(``learn/xgboost/``: ``booster=gbtree, objective=binary:logistic,
+num_round, dsplit=row`` over rabit histogram allreduce — the reference
+builds external xgboost against shared dmlc-core, Makefile:24-28, and its
+distributed mode allreduces per-level gradient histograms,
+xgboost/README.md:27-55).
+
+TPU mapping (SURVEY.md §7 stage 7 — "the rabit→ICI shim's stress test"):
+
+- features are quantile-binned to uint8 on the host once (the hist
+  algorithm's sketch);
+- each tree grows depth-wise: one jitted level step computes the
+  (nodes, features, bins, grad/hess) histogram as a scatter-add over rows
+  sharded on the ``data`` mesh axis — the replicated output IS the
+  histogram allreduce, XLA inserts the psum — then best-split gain via
+  cumulative bin sums, then row routing;
+- no data-dependent control flow: every node of a level splits in parallel
+  (non-splitting nodes become leaves and their rows stop contributing via a
+  row mask); shapes are static in (level, features, bins).
+
+Node ids are heap order (root 0, children 2i+1/2i+2); per level the local
+id is ``global − (2^depth − 1)`` so a parent's local children are 2j and
+2j+1. The model dump matches the xgboost text dump shape: one line per
+node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.ops.metrics import accuracy, auc, logloss
+from wormhole_tpu.parallel.checkpoint import Checkpointer
+from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("gbdt")
+
+
+@dataclass
+class GBDTConfig:
+    num_round: int = 10            # boosting rounds (mushroom.hadoop.conf)
+    max_depth: int = 6
+    eta: float = 0.3               # shrinkage (xgboost default)
+    reg_lambda: float = 1.0        # L2 on leaf weights
+    gamma: float = 0.0             # min split gain
+    min_child_weight: float = 1.0  # min hessian sum per child
+    num_bins: int = 256            # uint8 histogram bins
+    objective: str = "binary:logistic"
+    base_score: float = 0.5        # initial prediction (probability space)
+    checkpoint_dir: str = ""
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Tree:
+    """Complete binary tree in heap order; internal nodes carry
+    feature/split_bin, leaves carry weight."""
+    feature: jax.Array    # int32 (nnodes,)
+    split_bin: jax.Array  # int32 (nnodes,)  go right iff bin > split_bin
+    is_leaf: jax.Array    # bool  (nnodes,)
+    weight: jax.Array     # f32   (nnodes,)
+
+
+def _grad_hess(margin: jax.Array, labels: jax.Array, objective: str):
+    if objective == "binary:logistic":
+        p = jax.nn.sigmoid(margin)
+        return p - labels, p * (1.0 - p)
+    if objective == "reg:squarederror":
+        return margin - labels, jnp.ones_like(margin)
+    raise ValueError(f"unknown objective {objective!r}")
+
+
+@partial(jax.jit, static_argnames=("num_nodes", "num_bins", "lam", "gamma",
+                                   "min_child"))
+def _grow_level(bins: jax.Array, node: jax.Array, grad: jax.Array,
+                hess: jax.Array, row_mask: jax.Array, active: jax.Array, *,
+                num_nodes: int, num_bins: int, lam: float, gamma: float,
+                min_child: float):
+    """One depth level for all its nodes at once.
+
+    bins (n, F) uint8; node (n,) int32 LOCAL node id of each row within
+    this level; row_mask (n,) 0 for rows already parked on a leaf (or data
+    padding); active (num_nodes,) bool. Returns per-node split decisions,
+    per-node leaf values, and per-row go_right bits.
+    """
+    n, F = bins.shape
+
+    # histogram scatter: (2, nodes·F·bins) flat, one pass for grad and hess
+    f_idx = jnp.arange(F, dtype=jnp.int32)[None, :]
+    flat = (node[:, None] * (F * num_bins) + f_idx * num_bins
+            + bins.astype(jnp.int32)).reshape(-1)
+    gm = (grad * row_mask)[:, None]
+    hm = (hess * row_mask)[:, None]
+    ghist = jnp.zeros(num_nodes * F * num_bins, jnp.float32).at[flat].add(
+        jnp.broadcast_to(gm, (n, F)).reshape(-1)
+    ).reshape(num_nodes, F, num_bins)
+    hhist = jnp.zeros(num_nodes * F * num_bins, jnp.float32).at[flat].add(
+        jnp.broadcast_to(hm, (n, F)).reshape(-1)
+    ).reshape(num_nodes, F, num_bins)
+
+    # gain for every (node, feature, threshold): left = bins ≤ b
+    gl = jnp.cumsum(ghist, axis=-1)
+    hl = jnp.cumsum(hhist, axis=-1)
+    gtot, htot = gl[..., -1:], hl[..., -1:]
+    gr, hr = gtot - gl, htot - hl
+    gain = (gl * gl / (hl + lam) + gr * gr / (hr + lam)
+            - gtot * gtot / (htot + lam))
+    ok = (hl >= min_child) & (hr >= min_child)
+    gain = jnp.where(ok, gain, -jnp.inf)
+    gain = gain.at[..., -1].set(-jnp.inf)  # "everything left" isn't a split
+
+    flat_gain = gain.reshape(num_nodes, F * num_bins)
+    best = jnp.argmax(flat_gain, axis=-1)
+    best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+    best_f = (best // num_bins).astype(jnp.int32)
+    best_b = (best % num_bins).astype(jnp.int32)
+
+    do_split = active & (best_gain > gamma) & jnp.isfinite(best_gain)
+    leaf_w = -gtot[:, 0, 0] / (htot[:, 0, 0] + lam)
+
+    # per-row routing bit from the row's node's chosen split
+    row_f = best_f[node]
+    row_bin = jnp.take_along_axis(bins, row_f[:, None], 1)[:, 0]
+    go_right = (row_bin.astype(jnp.int32) > best_b[node]).astype(jnp.int32)
+    return do_split, best_f, best_b, leaf_w, go_right
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _predict_trees(feature: jax.Array, split_bin: jax.Array,
+                   is_leaf: jax.Array, weight: jax.Array,
+                   bins: jax.Array, *, depth: int) -> jax.Array:
+    """Margin contribution of a stack of trees (T, nnodes) for all rows —
+    depth gathers per tree, vmapped over the tree axis, summed."""
+
+    def one(feat, sb, leaf, wgt):
+        node = jnp.zeros(bins.shape[0], jnp.int32)
+        for _ in range(depth):
+            f = feat[node]
+            b = jnp.take_along_axis(bins, f[:, None], 1)[:, 0]
+            go = (b.astype(jnp.int32) > sb[node]).astype(jnp.int32)
+            nxt = 2 * node + 1 + go
+            node = jnp.where(leaf[node], node, nxt)
+        return wgt[node]
+
+    per_tree = jax.vmap(one)(feature, split_bin, is_leaf, weight)  # (T, n)
+    return jnp.sum(per_tree, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# host-side quantile binning (the hist sketch)
+# ---------------------------------------------------------------------------
+
+def quantile_bins(x: np.ndarray, num_bins: int = 256
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-feature quantile cuts → (bins uint8 (n,F), cuts (F, B-1)).
+    bin = #cuts < value (so ties go left of the cut)."""
+    qs = np.linspace(0, 100, num_bins + 1)[1:-1]
+    cuts = np.percentile(x, qs, axis=0).T.astype(np.float32)  # (F, B-1)
+    return apply_bins(x, cuts), cuts
+
+
+def apply_bins(x: np.ndarray, cuts: np.ndarray) -> np.ndarray:
+    n, F = x.shape
+    bins = np.empty((n, F), np.uint8)
+    for f in range(F):
+        bins[:, f] = np.searchsorted(cuts[f], x[:, f], side="left")
+    return bins
+
+
+# ---------------------------------------------------------------------------
+# booster
+# ---------------------------------------------------------------------------
+
+class GBDT:
+    """Depth-wise hist booster (the xgboost.dmlc capability)."""
+
+    def __init__(self, cfg: GBDTConfig,
+                 runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.rt = runtime or MeshRuntime.create()
+        self.ckpt = Checkpointer(cfg.checkpoint_dir)
+        self.trees: List[Tree] = []
+        self.cuts: Optional[np.ndarray] = None
+        self.base_margin = float(np.log(cfg.base_score
+                                        / (1 - cfg.base_score)))
+        self.history: List[float] = []  # train metric per round
+
+    def _shard_rows(self, arr):
+        if DATA_AXIS in self.rt.mesh.axis_names and self.rt.data_axis_size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(arr,
+                                  NamedSharding(self.rt.mesh, P(DATA_AXIS)))
+        return jax.device_put(arr)
+
+    # -- one tree -----------------------------------------------------------
+
+    def _build_tree(self, bins: jax.Array, grad: jax.Array,
+                    hess: jax.Array, data_mask: jax.Array) -> Tree:
+        cfg = self.cfg
+        d = cfg.max_depth
+        nnodes = 2 ** (d + 1) - 1
+        feature = np.zeros(nnodes, np.int32)
+        split_bin = np.zeros(nnodes, np.int32)
+        is_leaf = np.zeros(nnodes, bool)
+        weight = np.zeros(nnodes, np.float32)
+
+        n = bins.shape[0]
+        node = jnp.zeros(n, jnp.int32)      # local id within current level
+        row_mask = jnp.asarray(data_mask)   # 0 once parked on a leaf
+        active = np.ones(1, bool)
+        for depth in range(d + 1):
+            level_nodes = 2 ** depth
+            offset = level_nodes - 1        # first global id of this level
+            do_split_d, bf_d, bb_d, leaf_w_d, go_right = _grow_level(
+                bins, node, grad, hess, row_mask, jnp.asarray(active),
+                num_nodes=level_nodes, num_bins=cfg.num_bins,
+                lam=cfg.reg_lambda, gamma=cfg.gamma,
+                min_child=cfg.min_child_weight)
+            do_split = np.array(do_split_d)  # writable copy
+            if depth == d:                  # bottom level: all leaves
+                do_split[:] = False
+            ids = offset + np.arange(level_nodes)
+            newly_leaf = active & ~do_split
+            is_leaf[ids[newly_leaf]] = True
+            weight[ids[newly_leaf]] = np.asarray(leaf_w_d)[newly_leaf]
+            feature[ids[do_split]] = np.asarray(bf_d)[do_split]
+            split_bin[ids[do_split]] = np.asarray(bb_d)[do_split]
+            if not do_split.any():
+                break
+            # rows on split nodes descend (local child id = 2j + go);
+            # rows on fresh leaves stop contributing
+            on_split = jnp.asarray(do_split)[node]
+            node = jnp.where(on_split, 2 * node + go_right, 0)
+            row_mask = row_mask * on_split
+            nxt_active = np.zeros(2 * level_nodes, bool)
+            sp = np.nonzero(do_split)[0]
+            nxt_active[2 * sp] = True
+            nxt_active[2 * sp + 1] = True
+            active = nxt_active
+        return Tree(feature=jnp.asarray(feature),
+                    split_bin=jnp.asarray(split_bin),
+                    is_leaf=jnp.asarray(is_leaf),
+                    weight=jnp.asarray(weight))
+
+    # -- boosting -----------------------------------------------------------
+
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            sample_mask: Optional[np.ndarray] = None) -> "GBDT":
+        """Train on a dense (n, F) matrix (rows = this host's dsplit=row
+        shard). Resumes from the checkpointed round when configured."""
+        cfg = self.cfg
+        bins_np, self.cuts = quantile_bins(x, cfg.num_bins)
+        bins = self._shard_rows(bins_np)
+        labels = self._shard_rows(np.asarray(y, np.float32))
+        mask = self._shard_rows(
+            np.ones(len(y), np.float32) if sample_mask is None
+            else np.asarray(sample_mask, np.float32))
+
+        start_round, state = self._load_checkpoint()
+        margin = self._margin(bins_np, len(self.trees)) if self.trees else \
+            jnp.full(len(y), self.base_margin)
+        margin = self._shard_rows(np.asarray(margin))
+
+        for r in range(start_round, cfg.num_round):
+            grad, hess = _grad_hess(margin, labels, cfg.objective)
+            tree = self._build_tree(bins, grad, hess, mask)
+            # shrink leaf weights by eta (xgboost shrinkage)
+            tree = Tree(feature=tree.feature, split_bin=tree.split_bin,
+                        is_leaf=tree.is_leaf, weight=tree.weight * cfg.eta)
+            self.trees.append(tree)
+            margin = margin + _predict_trees(
+                tree.feature[None], tree.split_bin[None],
+                tree.is_leaf[None], tree.weight[None], bins,
+                depth=cfg.max_depth + 1)
+            metric = float(logloss(labels, margin, mask)) \
+                if cfg.objective == "binary:logistic" else \
+                float(jnp.sum((margin - labels) ** 2 * mask)
+                      / jnp.maximum(jnp.sum(mask), 1))
+            self.history.append(metric)
+            log.info("round %d: train %s=%.6f", r,
+                     "logloss" if cfg.objective == "binary:logistic"
+                     else "mse", metric)
+            self._save_checkpoint(r + 1)
+        return self
+
+    # -- inference ----------------------------------------------------------
+
+    def _stacked(self):
+        return (jnp.stack([t.feature for t in self.trees]),
+                jnp.stack([t.split_bin for t in self.trees]),
+                jnp.stack([t.is_leaf for t in self.trees]),
+                jnp.stack([t.weight for t in self.trees]))
+
+    def _margin(self, bins_np: np.ndarray, upto: Optional[int] = None):
+        trees = self.trees[:upto] if upto is not None else self.trees
+        if not trees:
+            return np.full(bins_np.shape[0], self.base_margin, np.float32)
+        f, s, l, w = (jnp.stack([t.feature for t in trees]),
+                      jnp.stack([t.split_bin for t in trees]),
+                      jnp.stack([t.is_leaf for t in trees]),
+                      jnp.stack([t.weight for t in trees]))
+        return self.base_margin + _predict_trees(
+            f, s, l, w, jnp.asarray(bins_np), depth=self.cfg.max_depth + 1)
+
+    def predict_margin(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._margin(apply_bins(x, self.cuts)))
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(jax.nn.sigmoid(
+            jnp.asarray(self.predict_margin(x))))
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray) -> dict:
+        m = jnp.asarray(self.predict_margin(x))
+        labels = jnp.asarray(y, jnp.float32)
+        mask = jnp.ones_like(labels)
+        return {"auc": float(auc(labels, m, mask)),
+                "accuracy": float(accuracy(labels, m, mask)),
+                "logloss": float(logloss(labels, m, mask))}
+
+    # -- checkpoint / model IO ----------------------------------------------
+
+    def _ckpt_template(self):
+        nnodes = 2 ** (self.cfg.max_depth + 1) - 1
+        zt = Tree(feature=np.zeros(nnodes, np.int32),
+                  split_bin=np.zeros(nnodes, np.int32),
+                  is_leaf=np.zeros(nnodes, bool),
+                  weight=np.zeros(nnodes, np.float32))
+        return zt
+
+    def _load_checkpoint(self):
+        if not self.cfg.checkpoint_dir:
+            return 0, None
+        ver = self.ckpt.latest_version()
+        if not ver:
+            return 0, None
+        template = {"trees": [self._ckpt_template() for _ in range(ver)],
+                    "cuts": np.zeros_like(self.cuts)}
+        _, state = self.ckpt.load(template)
+        self.trees = [Tree(**{k: jnp.asarray(v) for k, v in
+                              zip(("feature", "split_bin", "is_leaf",
+                                   "weight"),
+                                  (t.feature, t.split_bin, t.is_leaf,
+                                   t.weight))})
+                      for t in state["trees"]]
+        self.cuts = np.asarray(state["cuts"])
+        log.info("resumed from round %d", ver)
+        return ver, state
+
+    def _save_checkpoint(self, version: int) -> None:
+        if not self.cfg.checkpoint_dir:
+            return
+        self.ckpt.save(version, {"trees": self.trees, "cuts": self.cuts})
+
+    def dump_model(self, path: str) -> None:
+        """xgboost-style text dump: one line per node per tree."""
+        from wormhole_tpu.data.stream import open_stream
+        with open_stream(path, "w") as fh:
+            for ti, t in enumerate(self.trees):
+                fh.write(f"booster[{ti}]:\n")
+                feat = np.asarray(t.feature)
+                sb = np.asarray(t.split_bin)
+                leaf = np.asarray(t.is_leaf)
+                wgt = np.asarray(t.weight)
+                for i in range(len(feat)):
+                    if leaf[i]:
+                        fh.write(f"{i}:leaf={wgt[i]:.6g}\n")
+                    elif _node_reachable(leaf, i):
+                        cut = self._cut_value(feat[i], sb[i])
+                        fh.write(f"{i}:[f{feat[i]}<{cut:.6g}] "
+                                 f"yes={2 * i + 1},no={2 * i + 2}\n")
+
+    def _cut_value(self, f: int, b: int) -> float:
+        cuts = self.cuts[f]
+        return float(cuts[min(b, len(cuts) - 1)])
+
+
+def _node_reachable(is_leaf: np.ndarray, i: int) -> bool:
+    """A node is part of the tree iff no ancestor is a leaf."""
+    while i > 0:
+        i = (i - 1) // 2
+        if is_leaf[i]:
+            return False
+    return True

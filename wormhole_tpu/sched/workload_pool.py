@@ -42,8 +42,9 @@ class Workload:
 @dataclass
 class _Assigned:
     wl: Workload
-    worker: object
-    start: float = field(default_factory=time.monotonic)
+    workers: set            # every worker holding a copy of this part
+    start: float            # first assignment (straggler clock)
+    last_start: float       # most recent assignment (duration stats)
     is_rerun: bool = False
 
 
@@ -91,13 +92,17 @@ class WorkloadPool:
             if wl.id in self._done_ids:
                 continue  # completed by another copy while re-queued
             existing = self._assigned.get(wl.id)
+            now = self._time()
             if existing is not None:
-                # a straggler copy: keep the original record (its is_rerun
-                # guard stays set, so the task is never re-issued a 3rd
-                # time, and the original's finish/reset bookkeeping holds)
+                # a straggler copy: the is_rerun guard stays set (never a
+                # 3rd unprompted copy), but the new worker is tracked so
+                # its death re-queues the part, and duration stats use the
+                # fresh start
                 existing.is_rerun = True
+                existing.workers.add(worker)
+                existing.last_start = now
             else:
-                self._assigned[wl.id] = _Assigned(wl, worker, self._time())
+                self._assigned[wl.id] = _Assigned(wl, {worker}, now, now)
             return wl
         return None
 
@@ -106,7 +111,9 @@ class WorkloadPool:
         straggler threshold (workload_pool.h:131-148)."""
         a = self._assigned.pop(workload_id, None)
         if a is not None:
-            dur = self._time() - a.start
+            # most-recent start: a fast rerun copy must not record the
+            # straggler's inflated elapsed time into the mean
+            dur = self._time() - a.last_start
             self._durations.append(dur)
             log.info("finished part %d of %s in %.2fs", a.wl.part,
                      a.wl.file, dur)
@@ -117,9 +124,13 @@ class WorkloadPool:
         """Node-failure handler: re-queue everything assigned to ``worker``
         (AddNodeFailureHandler → pool_.Reset, async_sgd.h:248-250)."""
         dead = [wid for wid, a in self._assigned.items()
-                if a.worker == worker]
+                if worker in a.workers]
         for wid in dead:
-            a = self._assigned.pop(wid)
+            a = self._assigned[wid]
+            a.workers.discard(worker)
+            if a.workers:
+                continue  # another copy is still running this part
+            self._assigned.pop(wid)
             log.info("re-queue part %d of %s from failed worker %r",
                      a.wl.part, a.wl.file, worker)
             self._queue.insert(0, a.wl)

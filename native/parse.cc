@@ -1,0 +1,293 @@
+// Native chunk parsers for wormhole-tpu: libsvm / criteo / adfea.
+//
+// The streaming-throughput hot path (SURVEY.md §7 hard part (d): the
+// reference parses at GB/s in C++ — learn/linear/base/{criteo_parser.h,
+// adfea_parser.h} and dmlc-core's libsvm parser; a Python host can't feed a
+// TPU pod at that rate). Semantics mirror wormhole_tpu/data/parsers.py
+// exactly — the Python implementations are the spec, and
+// tests/test_native_parser.py asserts byte-for-byte parity.
+//
+// ABI (consumed via ctypes from wormhole_tpu/data/native.py):
+//   int64 wh_parse_count(fmt, buf, len, int64 out[2])  -> 0 ok, <0 error;
+//       out = {rows, nnz}
+//   int   wh_parse_fill(fmt, buf, len, offsets, labels, index, values,
+//                       int* has_value)                -> 0 ok, <0 error
+// The count call parses and caches (thread-local, keyed by fmt/buf/len);
+// the fill call normally just copies the cached result out.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  std::vector<int64_t> offsets{0};
+  std::vector<float> labels;
+  std::vector<uint64_t> index;
+  std::vector<float> values;
+  bool has_value = false;
+  void clear() {
+    offsets.assign(1, 0);
+    labels.clear();
+    index.clear();
+    values.clear();
+    has_value = false;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// zlib-compatible CRC-32 (IEEE 802.3, reflected, poly 0xEDB88320) — must
+// match Python zlib.crc32 for criteo categorical hashing parity.
+// ---------------------------------------------------------------------------
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const Crc32Table kCrc;
+
+uint32_t crc32(const char* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    c = kCrc.t[(c ^ static_cast<uint8_t>(p[i])) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// tokenizing helpers
+// ---------------------------------------------------------------------------
+
+inline bool is_space(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' ||
+         c == '\f';
+}
+
+// strict numeric parses: the whole [s, e) range must be consumed, matching
+// Python's float()/int() which raise on any trailing garbage or emptiness —
+// malformed tokens must fail the parse, not silently read past the token.
+inline bool to_f32(const char* s, const char* e, float* out) {
+  if (s >= e) return false;
+  char* ep;
+  *out = strtof(s, &ep);
+  return ep == e;
+}
+
+inline bool to_u64(const char* s, const char* e, uint64_t* out) {
+  if (s >= e) return false;
+  char* ep;
+  *out = strtoull(s, &ep, 10);
+  return ep == e;
+}
+
+inline bool to_i64(const char* s, const char* e, int64_t* out) {
+  if (s >= e) return false;
+  char* ep;
+  *out = strtoll(s, &ep, 10);
+  return ep == e;
+}
+
+// line splitting with bytes.splitlines() semantics: '\n', '\r', and the
+// "\r\n" pair all terminate a line.
+inline void next_line(const char* p, const char* end, const char** line_end,
+                      const char** next) {
+  const char* q = p;
+  while (q < end && *q != '\n' && *q != '\r') ++q;
+  *line_end = q;
+  if (q < end) {
+    if (*q == '\r' && q + 1 < end && q[1] == '\n') q += 2;
+    else ++q;
+  }
+  *next = q;
+}
+
+// libsvm: "<label> <idx>:<val> ..."; binary tokens without ':' allowed;
+// a first token containing ':' means an unlabeled (prediction) row.
+bool parse_libsvm(const char* buf, int64_t len, Parsed* out) {
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* line_end;
+    const char* next;
+    next_line(p, end, &line_end, &next);
+    bool first = true;
+    bool any = false;
+    while (p < line_end) {
+      while (p < line_end && is_space(*p)) ++p;
+      if (p >= line_end) break;
+      const char* tok = p;
+      while (p < line_end && !is_space(*p)) ++p;
+      const char* tok_end = p;
+      const char* colon = static_cast<const char*>(
+          memchr(tok, ':', static_cast<size_t>(tok_end - tok)));
+      if (first) {
+        first = false;
+        any = true;
+        if (!colon) {  // labeled row
+          float lab;
+          if (!to_f32(tok, tok_end, &lab)) return false;
+          out->labels.push_back(lab);
+          continue;
+        }
+        out->labels.push_back(0.0f);  // unlabeled: token is a feature
+      }
+      if (colon == tok) continue;  // ":v" — empty key, skip (parity)
+      uint64_t key;
+      if (!to_u64(tok, colon ? colon : tok_end, &key)) return false;
+      out->index.push_back(key);
+      if (colon) {
+        float v;
+        if (!to_f32(colon + 1, tok_end, &v)) return false;
+        out->has_value = true;
+        out->values.push_back(v);
+      } else {
+        out->values.push_back(1.0f);
+      }
+    }
+    if (any) out->offsets.push_back(static_cast<int64_t>(out->index.size()));
+    p = next;
+  }
+  return true;
+}
+
+// criteo text: "<label>\t<13 ints>\t<26 categorical hex strings>"; int slot
+// i offsets by i*(2^64/13+1); categoricals crc32-hashed. All binary.
+bool parse_criteo(const char* buf, int64_t len, Parsed* out) {
+  constexpr uint64_t kItv = (~0ULL) / 13 + 1;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* line_end;
+    const char* next;
+    next_line(p, end, &line_end, &next);
+    if (line_end > p) {
+      // split on tabs
+      const char* cols[40];
+      size_t lens[40];
+      int ncol = 0;
+      const char* q = p;
+      while (q <= line_end && ncol < 40) {
+        const char* tab = static_cast<const char*>(
+            memchr(q, '\t', static_cast<size_t>(line_end - q)));
+        const char* ce = tab ? tab : line_end;
+        cols[ncol] = q;
+        lens[ncol] = static_cast<size_t>(ce - q);
+        ++ncol;
+        if (!tab) break;
+        q = tab + 1;
+      }
+      if (ncol >= 14) {
+        float lab;
+        if (!to_f32(cols[0], cols[0] + lens[0], &lab)) return false;
+        out->labels.push_back(lab);
+        for (int i = 0; i < 13; ++i) {
+          if (lens[1 + i]) {
+            int64_t v;
+            if (!to_i64(cols[1 + i], cols[1 + i] + lens[1 + i], &v))
+              return false;
+            out->index.push_back(static_cast<uint64_t>(v) +
+                                 static_cast<uint64_t>(i) * kItv);
+          }
+        }
+        int last = ncol < 40 ? ncol : 40;
+        for (int i = 14; i < last; ++i)
+          if (lens[i]) out->index.push_back(crc32(cols[i], lens[i]));
+        out->offsets.push_back(static_cast<int64_t>(out->index.size()));
+      }
+    }
+    p = next;
+  }
+  return true;
+}
+
+// adfea: whitespace token state machine; "feaid:groupid" appends feaid;
+// every 3rd bare integer is the label (lineid, count skipped) and closes
+// the previous row.
+bool parse_adfea(const char* buf, int64_t len, Parsed* out) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int bare = 0;
+  while (p < end) {
+    while (p < end && is_space(*p)) ++p;
+    if (p >= end) break;
+    const char* tok = p;
+    while (p < end && !is_space(*p)) ++p;
+    const char* tok_end = p;
+    const char* colon = static_cast<const char*>(
+        memchr(tok, ':', static_cast<size_t>(tok_end - tok)));
+    if (colon) {
+      uint64_t key;
+      if (!to_u64(tok, colon, &key)) return false;
+      out->index.push_back(key);
+    } else if (bare == 2) {
+      bare = 0;
+      if (!out->labels.empty())
+        out->offsets.push_back(static_cast<int64_t>(out->index.size()));
+      out->labels.push_back(tok[0] == '1' ? 1.0f : 0.0f);
+    } else {
+      ++bare;
+    }
+  }
+  if (!out->labels.empty())
+    out->offsets.push_back(static_cast<int64_t>(out->index.size()));
+  return true;
+}
+
+bool parse(const char* fmt, const char* buf, int64_t len, Parsed* out) {
+  out->clear();
+  if (strcmp(fmt, "libsvm") == 0) return parse_libsvm(buf, len, out);
+  if (strcmp(fmt, "criteo") == 0) return parse_criteo(buf, len, out);
+  if (strcmp(fmt, "adfea") == 0) return parse_adfea(buf, len, out);
+  return false;
+}
+
+// thread-local cache: count() parses, fill() copies out
+thread_local Parsed g_cache;
+thread_local const char* g_key_buf = nullptr;
+thread_local int64_t g_key_len = -1;
+thread_local char g_key_fmt[16] = {0};
+
+}  // namespace
+
+extern "C" {
+
+int64_t wh_parse_count(const char* fmt, const char* buf, int64_t len,
+                       int64_t* out) {
+  if (!parse(fmt, buf, len, &g_cache)) return -1;
+  g_key_buf = buf;
+  g_key_len = len;
+  strncpy(g_key_fmt, fmt, sizeof(g_key_fmt) - 1);
+  out[0] = static_cast<int64_t>(g_cache.labels.size());
+  out[1] = static_cast<int64_t>(g_cache.index.size());
+  return 0;
+}
+
+int wh_parse_fill(const char* fmt, const char* buf, int64_t len,
+                  int64_t* offsets, float* labels, uint64_t* index,
+                  float* values, int* has_value) {
+  if (buf != g_key_buf || len != g_key_len ||
+      strncmp(fmt, g_key_fmt, sizeof(g_key_fmt)) != 0) {
+    if (!parse(fmt, buf, len, &g_cache)) return -1;  // cache miss: re-parse
+  }
+  const Parsed& c = g_cache;
+  memcpy(offsets, c.offsets.data(), c.offsets.size() * sizeof(int64_t));
+  memcpy(labels, c.labels.data(), c.labels.size() * sizeof(float));
+  memcpy(index, c.index.data(), c.index.size() * sizeof(uint64_t));
+  if (c.has_value) {
+    memcpy(values, c.values.data(), c.values.size() * sizeof(float));
+  }
+  *has_value = c.has_value ? 1 : 0;
+  g_key_buf = nullptr;  // single use; bytes object may be freed after this
+  return 0;
+}
+
+}  // extern "C"

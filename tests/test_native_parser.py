@@ -1,0 +1,133 @@
+"""Native C++ parser parity: the Python parsers are the spec; the native
+library must produce identical RowBlocks (offsets, labels, 64-bit ids,
+values) for libsvm / criteo / adfea, including edge cases. Plus a
+throughput sanity check (the reason the native path exists)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.data import native
+from wormhole_tpu.data.parsers import (parse_adfea_chunk,
+                                       parse_criteo_chunk,
+                                       parse_libsvm_chunk)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library not built")
+
+_PY = {"libsvm": parse_libsvm_chunk, "criteo": parse_criteo_chunk,
+       "adfea": parse_adfea_chunk}
+
+
+def assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_allclose(a.label, b.label, rtol=1e-6)
+    np.testing.assert_array_equal(a.index, b.index)
+    if a.value is None or b.value is None:
+        assert a.value is None and b.value is None
+    else:
+        np.testing.assert_allclose(a.value, b.value, rtol=1e-6)
+
+
+def check(fmt: str, chunk: bytes):
+    nat = native.get_parser(fmt)
+    assert nat is not None
+    assert_blocks_equal(nat(chunk), _PY[fmt](chunk))
+
+
+def test_libsvm_parity(rng):
+    lines = []
+    for i in range(200):
+        nnz = rng.integers(1, 10)
+        idx = np.sort(rng.choice(10_000, size=nnz, replace=False))
+        vals = rng.standard_normal(nnz)
+        feats = " ".join(f"{j}:{v:.6g}" for j, v in zip(idx, vals))
+        lines.append(f"{rng.integers(0, 2)} {feats}")
+    check("libsvm", ("\n".join(lines) + "\n").encode())
+
+
+def test_libsvm_binary_and_edge_cases():
+    chunk = (b"1 3 5 7\n"          # binary features, no values
+             b"0 2:0.5\n"          # single valued feature
+             b"4:1 9:2\n"          # unlabeled (prediction) row
+             b"-1 18446744073709551615:3.5\n"  # uint64-max feature id
+             b"\n"                 # empty line
+             b"1 6:1e-3 2:-4.5\n")
+    check("libsvm", chunk)
+
+
+def test_libsvm_no_trailing_newline():
+    check("libsvm", b"1 2:3.5 7:1.25")
+
+
+def test_criteo_parity(rng):
+    lines = []
+    for _ in range(100):
+        ints = [str(rng.integers(-2, 1000)) if rng.random() > 0.2 else ""
+                for _ in range(13)]
+        cats = [f"{rng.integers(0, 2**32):08x}" if rng.random() > 0.2
+                else "" for _ in range(26)]
+        lines.append("\t".join([str(rng.integers(0, 2))] + ints + cats))
+    check("criteo", ("\n".join(lines) + "\n").encode())
+
+
+def test_criteo_short_line_skipped():
+    chunk = b"1\t2\t3\n" + b"\t".join(
+        [b"1"] + [b"5"] * 13 + [b"deadbeef"] * 26) + b"\n"
+    check("criteo", chunk)
+
+
+def test_adfea_parity(rng):
+    toks = []
+    for i in range(50):
+        toks.append(str(i))                       # lineid
+        toks.append(str(rng.integers(1, 5)))      # count
+        toks.append(str(rng.integers(0, 2)))      # label
+        for _ in range(rng.integers(1, 8)):
+            toks.append(f"{rng.integers(0, 10**12)}:{rng.integers(0, 100)}")
+    check("adfea", (" ".join(toks) + "\n").encode())
+
+
+def test_native_is_faster(rng):
+    """The whole point: native should beat Python by a wide margin on a
+    multi-MB chunk."""
+    lines = []
+    for i in range(20_000):
+        idx = np.sort(rng.choice(1_000_000, size=30, replace=False))
+        vals = rng.standard_normal(30)
+        feats = " ".join(f"{j}:{v:.6g}" for j, v in zip(idx, vals))
+        lines.append(f"{i % 2} {feats}")
+    chunk = ("\n".join(lines) + "\n").encode()
+
+    nat = native.get_parser("libsvm")
+    t0 = time.perf_counter()
+    blk_n = nat(chunk)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    blk_p = parse_libsvm_chunk(chunk)
+    t_python = time.perf_counter() - t0
+    assert_blocks_equal(blk_n, blk_p)
+    mbs = len(chunk) / 1e6 / t_native
+    print(f"\nnative: {mbs:.0f} MB/s ({t_python / t_native:.1f}x python)")
+    assert t_native < t_python  # conservatively: just faster
+
+def test_cr_line_terminators():
+    """bytes.splitlines semantics: lone \\r and \\r\\n both end a row."""
+    check("libsvm", b"1 2:3\r0 4:5\n1 6:7\r\n0 8:9")
+
+
+def test_malformed_input_errors():
+    """Python raises on malformed tokens; native must fail the parse too,
+    not silently mis-read."""
+    for fmt, chunk in [("libsvm", b"1 5: 7:2\n"),      # empty value
+                       ("libsvm", b"1 1:2:3\n"),       # double colon
+                       ("libsvm", b"xyz 1:2\n"),       # garbage label
+                       ("criteo", b"1\t" + b"zz\t" * 12 + b"z\t" +
+                        b"c\t" * 25 + b"c\n"),          # garbage int slot
+                       ("adfea", b"1 2 1 :5\n")]:       # empty adfea key
+        nat = native.get_parser(fmt)
+        with pytest.raises(ValueError):
+            nat(chunk)
+        with pytest.raises(ValueError):
+            _PY[fmt](chunk)

@@ -116,8 +116,14 @@ def pad_to_batch(loc: Localized, minibatch_size: int,
                        uniq_keys=uniq, key_mask=key_mask)
 
 
+def nnz_bucket(densest: int, cap: int = 4096) -> int:
+    """The per-row padded-nnz bucketing policy: power-of-two, min 8,
+    capped (denser rows are positionally truncated)."""
+    return min(next_bucket(max(densest, 1), 8), cap)
+
+
 def batch_max_nnz(blk: RowBlock, cap: int = 4096) -> int:
-    return min(next_bucket(max(blk.max_row_nnz(), 1), 8), cap)
+    return nnz_bucket(blk.max_row_nnz(), cap)
 
 
 @jax.tree_util.register_dataclass

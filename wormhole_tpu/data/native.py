@@ -40,6 +40,8 @@ def _load():
     if _TRIED:
         return _LIB
     _TRIED = True
+    if os.environ.get("WORMHOLE_DISABLE_NATIVE"):
+        return None
     path = _find_lib()
     if path is None:
         return None

@@ -29,7 +29,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from wormhole_tpu.data.feed import next_bucket, pad_to_batch
+from wormhole_tpu.data.feed import next_bucket, nnz_bucket, pad_to_batch
 from wormhole_tpu.data.localizer import Localizer
 from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.learners.handles import LearnRate, create_handle
@@ -89,9 +89,7 @@ class AsyncSGD:
             # cfg.max_nnz) are positionally truncated, loudly
             densest = blk.max_row_nnz()
             if not cfg.max_nnz:
-                self._max_nnz = max(self._max_nnz,
-                                    min(next_bucket(max(densest, 1), 8),
-                                        4096))
+                self._max_nnz = max(self._max_nnz, nnz_bucket(densest))
             if densest > self._max_nnz and not self._warned_trunc:
                 self._warned_trunc = True
                 log.warning(

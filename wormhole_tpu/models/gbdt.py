@@ -162,6 +162,8 @@ def quantile_bins(x: np.ndarray, num_bins: int = 256
                   ) -> Tuple[np.ndarray, np.ndarray]:
     """Per-feature quantile cuts → (bins uint8 (n,F), cuts (F, B-1)).
     bin = #cuts < value (so ties go left of the cut)."""
+    if num_bins > 256:
+        raise ValueError(f"num_bins {num_bins} > 256: bins are uint8")
     qs = np.linspace(0, 100, num_bins + 1)[1:-1]
     cuts = np.percentile(x, qs, axis=0).T.astype(np.float32)  # (F, B-1)
     return apply_bins(x, cuts), cuts
@@ -257,14 +259,19 @@ class GBDT:
         """Train on a dense (n, F) matrix (rows = this host's dsplit=row
         shard). Resumes from the checkpointed round when configured."""
         cfg = self.cfg
-        bins_np, self.cuts = quantile_bins(x, cfg.num_bins)
+        start_round = self._load_checkpoint(x.shape[1])
+        if self.cuts is not None:
+            # resumed: bin with the CHECKPOINTED cuts — fresh quantiles of
+            # this shard would disagree with the bins the saved trees split on
+            bins_np = apply_bins(x, self.cuts)
+        else:
+            bins_np, self.cuts = quantile_bins(x, cfg.num_bins)
         bins = self._shard_rows(bins_np)
         labels = self._shard_rows(np.asarray(y, np.float32))
         mask = self._shard_rows(
             np.ones(len(y), np.float32) if sample_mask is None
             else np.asarray(sample_mask, np.float32))
 
-        start_round, state = self._load_checkpoint()
         margin = self._margin(bins_np, len(self.trees)) if self.trees else \
             jnp.full(len(y), self.base_margin)
         margin = self._shard_rows(np.asarray(margin))
@@ -292,12 +299,6 @@ class GBDT:
         return self
 
     # -- inference ----------------------------------------------------------
-
-    def _stacked(self):
-        return (jnp.stack([t.feature for t in self.trees]),
-                jnp.stack([t.split_bin for t in self.trees]),
-                jnp.stack([t.is_leaf for t in self.trees]),
-                jnp.stack([t.weight for t in self.trees]))
 
     def _margin(self, bins_np: np.ndarray, upto: Optional[int] = None):
         trees = self.trees[:upto] if upto is not None else self.trees
@@ -335,24 +336,20 @@ class GBDT:
                   weight=np.zeros(nnodes, np.float32))
         return zt
 
-    def _load_checkpoint(self):
+    def _load_checkpoint(self, num_features: int) -> int:
         if not self.cfg.checkpoint_dir:
-            return 0, None
+            return 0
         ver = self.ckpt.latest_version()
         if not ver:
-            return 0, None
+            return 0
         template = {"trees": [self._ckpt_template() for _ in range(ver)],
-                    "cuts": np.zeros_like(self.cuts)}
+                    "cuts": np.zeros((num_features, self.cfg.num_bins - 1),
+                                     np.float32)}
         _, state = self.ckpt.load(template)
-        self.trees = [Tree(**{k: jnp.asarray(v) for k, v in
-                              zip(("feature", "split_bin", "is_leaf",
-                                   "weight"),
-                                  (t.feature, t.split_bin, t.is_leaf,
-                                   t.weight))})
-                      for t in state["trees"]]
+        self.trees = list(state["trees"])
         self.cuts = np.asarray(state["cuts"])
         log.info("resumed from round %d", ver)
-        return ver, state
+        return ver
 
     def _save_checkpoint(self, version: int) -> None:
         if not self.cfg.checkpoint_dir:

@@ -18,7 +18,7 @@ process), so "worker id" is any hashable caller identity.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from wormhole_tpu.data.stream import list_files
@@ -111,10 +111,13 @@ class WorkloadPool:
         straggler threshold (workload_pool.h:131-148)."""
         a = self._assigned.pop(workload_id, None)
         if a is not None:
-            # most-recent start: a fast rerun copy must not record the
-            # straggler's inflated elapsed time into the mean
             dur = self._time() - a.last_start
-            self._durations.append(dur)
+            if not a.is_rerun:
+                # duplicated parts are excluded from the duration stats:
+                # finish() can't tell which copy completed, and either
+                # choice (inflated straggler time or near-zero original-
+                # completes-after-rerun time) would skew the 3x threshold
+                self._durations.append(dur)
             log.info("finished part %d of %s in %.2fs", a.wl.part,
                      a.wl.file, dur)
         self._done_ids.add(workload_id)

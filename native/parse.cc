@@ -18,8 +18,13 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <vector>
+
+#if defined(__GLIBC__) || defined(__APPLE__)
+#define WH_HAVE_XLOCALE 1
+#endif
 
 namespace {
 
@@ -72,18 +77,39 @@ inline bool is_space(char c) {
          c == '\f';
 }
 
+// Numeric parsing must be locale-independent (Python float()/int() are; a
+// host library calling setlocale() must not change parse results).
+#ifdef WH_HAVE_XLOCALE
+struct CLocale {
+  locale_t loc;
+  CLocale() : loc(newlocale(LC_ALL_MASK, "C", nullptr)) {}
+};
+static const CLocale kCLoc;
+inline float wh_strtof(const char* s, char** ep) {
+  return strtof_l(s, ep, kCLoc.loc);
+}
+#else
+inline float wh_strtof(const char* s, char** ep) { return strtof(s, ep); }
+#endif
+
 // strict numeric parses: the whole [s, e) range must be consumed, matching
 // Python's float()/int() which raise on any trailing garbage or emptiness —
 // malformed tokens must fail the parse, not silently read past the token.
 inline bool to_f32(const char* s, const char* e, float* out) {
   if (s >= e) return false;
+  // Python float() rejects C99 hex-float syntax that strtof accepts
+  if (memchr(s, 'x', static_cast<size_t>(e - s)) ||
+      memchr(s, 'X', static_cast<size_t>(e - s)))
+    return false;
   char* ep;
-  *out = strtof(s, &ep);
+  *out = wh_strtof(s, &ep);
   return ep == e;
 }
 
 inline bool to_u64(const char* s, const char* e, uint64_t* out) {
   if (s >= e) return false;
+  if (*s == '-') return false;  // strtoull silently wraps negatives;
+                                // Python np.uint64 conversion raises
   char* ep;
   *out = strtoull(s, &ep, 10);
   return ep == e;

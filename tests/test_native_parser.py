@@ -131,3 +131,17 @@ def test_malformed_input_errors():
             nat(chunk)
         with pytest.raises(ValueError):
             _PY[fmt](chunk)
+
+
+def test_negative_id_and_hexfloat_rejected():
+    """Python rejects negative uint64 ids (OverflowError at np conversion)
+    and hex-float labels; native must reject them too."""
+    nat = native.get_parser("libsvm")
+    with pytest.raises(ValueError):
+        nat(b"1 -3:2.0\n")
+    with pytest.raises(ValueError):
+        nat(b"0x1p3 2:1\n")
+    with pytest.raises((ValueError, OverflowError)):
+        _PY["libsvm"](b"1 -3:2.0\n")
+    with pytest.raises(ValueError):
+        _PY["libsvm"](b"0x1p3 2:1\n")

@@ -1,0 +1,115 @@
+"""text2rec / print_rec tools and the app CLI entry points."""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.data.minibatch import MinibatchIter
+from wormhole_tpu.tools.text2rec import Text2RecConfig, convert
+
+
+def test_text2rec_roundtrip(tmp_path, rng):
+    # libsvm → rec → same rows through the training reader
+    src = tmp_path / "in.libsvm"
+    lines = []
+    for i in range(300):
+        nnz = rng.integers(1, 8)
+        idx = np.sort(rng.choice(1000, size=nnz, replace=False))
+        vals = rng.standard_normal(nnz)
+        feats = " ".join(f"{j}:{v:.6g}" for j, v in zip(idx, vals))
+        lines.append(f"{i % 2} {feats}")
+    src.write_text("\n".join(lines) + "\n")
+    dst = str(tmp_path / "out.rec")
+    n = convert(Text2RecConfig(input=str(src), output=dst, format="libsvm"))
+    assert n == 300
+
+    from wormhole_tpu.data.rowblock import concat_blocks
+    orig = concat_blocks(list(MinibatchIter(str(src), 0, 1, "libsvm", 512)))
+    conv = concat_blocks(list(MinibatchIter(dst, 0, 1, "recordio", 512)))
+    np.testing.assert_array_equal(orig.offset, conv.offset)
+    np.testing.assert_allclose(orig.label, conv.label)
+    np.testing.assert_array_equal(orig.index, conv.index)
+    np.testing.assert_allclose(orig.value, conv.value, rtol=1e-6)
+
+
+def test_text2rec_criteo_and_partitioned_read(tmp_path, rng):
+    src = tmp_path / "in.criteo"
+    lines = []
+    for _ in range(200):
+        ints = [str(rng.integers(0, 100)) for _ in range(13)]
+        cats = [f"{rng.integers(0, 2**32):08x}" for _ in range(26)]
+        lines.append("\t".join([str(rng.integers(0, 2))] + ints + cats))
+    src.write_text("\n".join(lines) + "\n")
+    dst = str(tmp_path / "out.rec")
+    assert convert(Text2RecConfig(input=str(src), output=dst,
+                                  format="criteo")) == 200
+    # part k/n reads of the rec file cover all rows exactly once
+    total = 0
+    for part in range(3):
+        for blk in MinibatchIter(dst, part, 3, "recordio", 512):
+            total += blk.size
+    assert total == 200
+
+
+def test_print_rec(tmp_path, rng, capsys):
+    src = tmp_path / "in.libsvm"
+    src.write_text("1 2:0.5 7:1.5\n0 3:2.5\n")
+    dst = str(tmp_path / "out.rec")
+    convert(Text2RecConfig(input=str(src), output=dst, format="libsvm"))
+    from wormhole_tpu.tools.print_rec import main
+    main([f"input={dst}", "limit=10"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out[0] == "1 2:0.5 7:1.5"
+    assert out[1] == "0 3:2.5"
+
+
+def test_kmeans_cli(tmp_path, rng, capsys):
+    path = tmp_path / "km.libsvm"
+    lines = []
+    for i in range(90):
+        base = (i % 3) * 10
+        feats = " ".join(f"{base + j}:1" for j in range(5))
+        lines.append(f"0 {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    out = str(tmp_path / "centroids.txt")
+    from wormhole_tpu.models.kmeans import main
+    main([f"data={path}", "num_clusters=3", "max_iter=4",
+          "minibatch_size=32", f"model_out={out}"])
+    cent = [ln for ln in open(out).read().splitlines() if ln.strip()]
+    assert len(cent) == 3
+
+
+def test_linear_cli_train_and_predict(tmp_path, rng):
+    path = tmp_path / "lin.libsvm"
+    w = rng.standard_normal(20)
+    lines = []
+    for _ in range(200):
+        x = (rng.random(20) < 0.4) * rng.standard_normal(20)
+        y = int(x @ w > 0)
+        feats = " ".join(f"{j}:{x[j]:.5g}" for j in np.nonzero(x)[0])
+        lines.append(f"{y} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    model = str(tmp_path / "model.bin")
+    pred = str(tmp_path / "pred.txt")
+    from wormhole_tpu.models.linear import main
+    main([f"train_data={path}", "reg_L2=0.1", "max_iter=15",
+          "minibatch_size=64", f"model_out={model}"])
+    main([f"train_data={path}", "task=predict", f"model_in={model}",
+          f"pred_out={pred}", "minibatch_size=64"])
+    preds = np.loadtxt(pred)
+    assert len(preds) == 200
+
+
+def test_gbdt_cli(tmp_path, rng):
+    path = tmp_path / "g.libsvm"
+    lines = []
+    for _ in range(300):
+        x = rng.standard_normal(6)
+        y = int((x[0] > 0) ^ (x[1] > 0))
+        feats = " ".join(f"{j}:{x[j]:.5g}" for j in range(6))
+        lines.append(f"{y} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    dump = str(tmp_path / "dump.txt")
+    from wormhole_tpu.models.gbdt import main
+    main([f"data={path}", "num_round=5", "max_depth=3",
+          f"model_dump={dump}"])
+    assert "booster[4]" in open(dump).read()

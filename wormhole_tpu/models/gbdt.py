@@ -266,14 +266,27 @@ class GBDT:
             bins_np = apply_bins(x, self.cuts)
         else:
             bins_np, self.cuts = quantile_bins(x, cfg.num_bins)
+        # pad rows to a multiple of the data axis (padded rows carry mask 0
+        # so they contribute nothing to histograms or metrics)
+        ds = max(self.rt.data_axis_size, 1)
+        n = bins_np.shape[0]
+        n_pad = -(-n // ds) * ds
+        mask_np = (np.ones(n, np.float32) if sample_mask is None
+                   else np.asarray(sample_mask, np.float32))
+        if n_pad != n:
+            bins_np = np.concatenate(
+                [bins_np, np.zeros((n_pad - n, bins_np.shape[1]),
+                                   np.uint8)])
+            mask_np = np.concatenate([mask_np,
+                                      np.zeros(n_pad - n, np.float32)])
+        y_pad = np.zeros(n_pad, np.float32)
+        y_pad[:n] = np.asarray(y, np.float32)
         bins = self._shard_rows(bins_np)
-        labels = self._shard_rows(np.asarray(y, np.float32))
-        mask = self._shard_rows(
-            np.ones(len(y), np.float32) if sample_mask is None
-            else np.asarray(sample_mask, np.float32))
+        labels = self._shard_rows(y_pad)
+        mask = self._shard_rows(mask_np)
 
         margin = self._margin(bins_np, len(self.trees)) if self.trees else \
-            jnp.full(len(y), self.base_margin)
+            jnp.full(bins_np.shape[0], self.base_margin)
         margin = self._shard_rows(np.asarray(margin))
 
         for r in range(start_round, cfg.num_round):
@@ -386,3 +399,66 @@ def _node_reachable(is_leaf: np.ndarray, i: int) -> bool:
         if is_leaf[i]:
             return False
     return True
+
+
+def load_dense(uri: str, data_format: str = "libsvm",
+               num_features: int = 0, part: int = 0, nparts: int = 1):
+    """Densify a sparse text/rec uri to (x (n,F) f32, y (n,)) — GBDT bins a
+    dense matrix (the reference feeds xgboost libsvm directly; hist-binning
+    wants columns)."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    from wormhole_tpu.data.rowblock import concat_blocks
+    blocks = list(MinibatchIter(uri, part, nparts, data_format, 1 << 16))
+    if not blocks:
+        raise FileNotFoundError(f"no rows in {uri}")
+    blk = concat_blocks(blocks)
+    if blk.max_index() >= (1 << 31):
+        raise ValueError(
+            f"feature id {blk.max_index()} too large to densify — GBDT "
+            "bins a dense matrix; hash/remap the feature space first")
+    f = num_features or blk.max_index() + 1
+    x = np.zeros((blk.size, f), np.float32)
+    vals = blk.values_or_ones()
+    for i in range(blk.size):
+        s, e = int(blk.offset[i]), int(blk.offset[i + 1])
+        ids = blk.index[s:e].astype(np.int64)
+        keep = ids < f  # unseen-at-train features are ignored (xgboost-like)
+        x[i, ids[keep]] = vals[s:e][keep]
+    return x, blk.label.copy()
+
+
+@dataclass
+class _GBDTCLI(GBDTConfig):
+    data: str = ""
+    val_data: str = ""
+    data_format: str = "libsvm"
+    model_dump: str = ""
+    mesh_shape: str = ""
+    num_features: int = 0
+
+
+def main(argv=None) -> int:
+    """CLI (reference mushroom.hadoop.conf ergonomics):
+    python -m wormhole_tpu.models.gbdt data=<uri> num_round=10 max_depth=6
+        [val_data=<uri>] [model_dump=<uri>]"""
+    import sys
+    from wormhole_tpu.utils.config import apply_kvs
+    cli = _GBDTCLI()
+    apply_kvs(cli, sys.argv[1:] if argv is None else argv)
+    if not cli.data:
+        raise SystemExit("need data=<uri>")
+    rt = MeshRuntime.create(cli.mesh_shape)
+    x, y = load_dense(cli.data, cli.data_format, cli.num_features)
+    model = GBDT(cli, rt)
+    model.fit(x, y)
+    log.info("train metrics: %s", model.evaluate(x, y))
+    if cli.val_data:
+        xv, yv = load_dense(cli.val_data, cli.data_format, x.shape[1])
+        log.info("val metrics: %s", model.evaluate(xv, yv))
+    if cli.model_dump:
+        model.dump_model(cli.model_dump)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -261,3 +261,34 @@ class KMeans:
         self.state = KMeansState(centroids=cent,
                                  version=np.zeros((), np.int32))
         return self.state
+
+
+@dataclass
+class _KMeansCLI(KMeansConfig):
+    data: str = ""
+    data_format: str = "libsvm"
+    model_out: str = ""
+    mesh_shape: str = ""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI (reference run_local.sh ergonomics):
+    python -m wormhole_tpu.models.kmeans data=<uri> num_clusters=K
+        max_iter=N [model_out=<uri>] [mesh_shape=data:8] [key=val ...]"""
+    import sys
+    from wormhole_tpu.utils.config import apply_kvs
+    cli = _KMeansCLI()
+    apply_kvs(cli, sys.argv[1:] if argv is None else argv)
+    if not cli.data:
+        raise SystemExit("need data=<uri>")
+    rt = MeshRuntime.create(cli.mesh_shape)
+    km = KMeans(cli, rt)
+    batches = km.load_batches(cli.data, cli.data_format)
+    km.fit(batches)
+    if cli.model_out:
+        km.save_model(cli.model_out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -197,6 +197,10 @@ class LinearLBFGS:
                            checkpoint_dir=cfg.checkpoint_dir)
         self.solver = LBFGSSolver(scfg, obj)
         w0 = jnp.zeros(cfg.num_features, jnp.float32)
+        if self.w is not None:
+            # warm start (reference model_in + Broadcast, linear.cc:115-123);
+            # zero-pad if the feature space grew past the model dim
+            w0 = w0.at[:self.w.shape[0]].set(self.w[:cfg.num_features])
         sh = self._w_sharding()
         if sh is not None:
             w0 = jax.device_put(w0, sh)
@@ -246,3 +250,66 @@ class LinearLBFGS:
         self.w = jnp.asarray(w)
         self.cfg.num_features = dim
         return self.w
+
+
+@dataclass
+class _LinearCLI(LinearConfig):
+    train_data: str = ""
+    val_data: str = ""
+    data_format: str = "libsvm"
+    model_in: str = ""
+    model_out: str = ""
+    mesh_shape: str = ""
+    task: str = "train"  # train | predict (reference TaskPred)
+    pred_out: str = ""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI (reference run-linear.sh ergonomics):
+    python -m wormhole_tpu.models.linear train_data=<uri> reg_l1=1
+        [val_data=<uri>] [model_out=<uri>] [task=predict model_in=...]"""
+    import sys
+    from wormhole_tpu.utils.config import apply_kvs
+    cli = _LinearCLI()
+    apply_kvs(cli, sys.argv[1:] if argv is None else argv,
+              aliases={"reg_L1": "reg_l1", "reg_L2": "reg_l2",
+                       "data": "train_data"})
+    rt = MeshRuntime.create(cli.mesh_shape)
+    app = LinearLBFGS(cli, rt)
+    if cli.task == "predict":
+        if not (cli.model_in and cli.train_data):
+            raise SystemExit("predict needs model_in= and train_data=")
+        app.load_model(cli.model_in)
+        batches = app.load_batches(cli.train_data, cli.data_format)
+        from wormhole_tpu.data.stream import open_stream
+        out = cli.pred_out or "pred.txt"
+        if rt.world > 1:
+            out = f"{out}_{rt.rank}"  # one shard per host, no clobbering
+        with open_stream(out, "w") as f:
+            for b in batches:
+                margins = app.predict_margin(b)
+                for m, keep in zip(margins, np.asarray(b.row_mask)):
+                    if keep:
+                        f.write(f"{m:.6g}\n")
+        return 0
+    if not cli.train_data:
+        raise SystemExit("need train_data=<uri>")
+    batches = app.load_batches(cli.train_data, cli.data_format)
+    f_data = app.cfg.num_features
+    if cli.model_in:
+        app.load_model(cli.model_in)  # warm start; fit() seeds w0 from it
+        # keep the larger feature space — gathers must never clamp
+        app.cfg.num_features = max(f_data, app.cfg.num_features)
+    app.fit(batches)
+    metrics = app.evaluate(batches)
+    log.info("train metrics: %s", metrics)
+    if cli.val_data:
+        vb = app.load_batches(cli.val_data, cli.data_format)
+        log.info("val metrics: %s", app.evaluate(vb))
+    if cli.model_out:
+        app.save_model(cli.model_out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+"""Data conversion tools (reference ``learn/linear/tool/``)."""

@@ -1,0 +1,43 @@
+"""print_rec: dump RecordIO sparse-row records as text (reference
+``learn/linear/tool/print_rec.cc``).
+
+Usage:
+  python -m wormhole_tpu.tools.print_rec input=<uri> [limit=10]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass
+from typing import List, Optional
+
+from wormhole_tpu.data.recordio import RecordStream, decode_row
+from wormhole_tpu.utils.config import apply_kvs
+
+
+@dataclass
+class PrintRecConfig:
+    input: str = ""
+    limit: int = 10
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    cfg = PrintRecConfig()
+    apply_kvs(cfg, sys.argv[1:] if argv is None else argv)
+    if not cfg.input:
+        raise ValueError("need input=<uri>")
+    for i, payload in enumerate(RecordStream(cfg.input)):
+        if cfg.limit and i >= cfg.limit:
+            break
+        label, index, value = decode_row(payload)
+        if value is None:
+            feats = " ".join(str(int(k)) for k in index)
+        else:
+            feats = " ".join(f"{int(k)}:{v:.6g}"
+                             for k, v in zip(index, value))
+        print(f"{label:g} {feats}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

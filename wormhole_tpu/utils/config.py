@@ -108,7 +108,7 @@ class Config:
     def merged(self, kvs: Sequence[str]) -> "Config":
         """Return a copy with ``key=value`` tokens merged over this config."""
         out = dataclasses.replace(self)
-        _apply_kvs(out, kvs)
+        apply_kvs(out, kvs)
         return out
 
 
@@ -145,8 +145,14 @@ def _coerce(ftype: Any, raw: str) -> Any:
     return raw
 
 
-def _apply_kvs(cfg: Config, kvs: Sequence[str]) -> None:
-    hints = typing.get_type_hints(Config)
+def apply_kvs(cfg: Any, kvs: Sequence[str],
+              aliases: Optional[dict] = None) -> None:
+    """Merge ``key=value`` tokens into ANY dataclass instance (typed by its
+    field annotations) — the ``param=val`` SetParam chain of the rabit apps
+    (lbfgs-linear/linear.cc:236-241) for arbitrary app configs."""
+    hints = typing.get_type_hints(type(cfg))
+    alias = dict(_ALIASES if isinstance(cfg, Config) else {})
+    alias.update(aliases or {})
     for tok in kvs:
         tok = tok.strip()
         if not tok or tok.startswith("#"):
@@ -158,7 +164,7 @@ def _apply_kvs(cfg: Config, kvs: Sequence[str]) -> None:
         else:
             raise ValueError(f"cannot parse config token {tok!r} (want key=val)")
         key = key.strip()
-        key = _ALIASES.get(key, key)
+        key = alias.get(key, key)
         if not hasattr(cfg, key):
             raise ValueError(f"unknown config key {key!r}")
         setattr(cfg, key, _coerce(hints[key], val))
@@ -203,6 +209,6 @@ def load_config(path: Optional[str] = None,
             text = text.decode("utf-8")
         lines = [ln.strip() for ln in text.splitlines()
                  if ln.strip() and not ln.strip().startswith("#")]
-        _apply_kvs(cfg, _append_repeated(lines))
-    _apply_kvs(cfg, list(argv))
+        apply_kvs(cfg, _append_repeated(lines))
+    apply_kvs(cfg, list(argv))
     return cfg

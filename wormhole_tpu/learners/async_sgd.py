@@ -51,18 +51,33 @@ class DivergedError(RuntimeError):
 class AsyncSGD:
     """Scheduler+worker in one host process per TPU host."""
 
-    def __init__(self, cfg: Config, runtime: Optional[MeshRuntime] = None):
+    def __init__(self, cfg: Config, runtime: Optional[MeshRuntime] = None,
+                 store=None):
+        """``store`` may be any object with the ShardedStore step surface
+        (train_step/eval_step/nnz_weight/save_model) — the FM and wide&deep
+        models plug in here with the same worker/scheduler pipeline."""
         self.cfg = cfg
         self.rt = runtime or MeshRuntime.create(cfg.mesh_shape)
-        lam = list(cfg.lambda_) + [0.0, 0.0]
-        penalty = L1L2(lambda1=lam[0], lambda2=lam[1])
-        handle = create_handle(cfg.algo.value, penalty,
-                               LearnRate(cfg.lr_eta, cfg.lr_beta))
-        self.store = ShardedStore(
-            StoreConfig(num_buckets=cfg.num_buckets, loss=cfg.loss.value,
-                        fixed_bytes=cfg.fixed_bytes,
-                        lr_theta=cfg.lr_theta),
-            handle, self.rt)
+        if store is None:
+            lam = list(cfg.lambda_) + [0.0, 0.0]
+            penalty = L1L2(lambda1=lam[0], lambda2=lam[1])
+            handle = create_handle(cfg.algo.value, penalty,
+                                   LearnRate(cfg.lr_eta, cfg.lr_beta))
+            store = ShardedStore(
+                StoreConfig(num_buckets=cfg.num_buckets,
+                            loss=cfg.loss.value,
+                            fixed_bytes=cfg.fixed_bytes,
+                            lr_theta=cfg.lr_theta),
+                handle, self.rt)
+        elif (buckets := getattr(getattr(store, "cfg", None),
+                                 "num_buckets", None)) is not None \
+                and buckets != cfg.num_buckets:
+            # the Localizer folds keys into cfg.num_buckets; a smaller table
+            # would silently clamp gathers/scatters inside jit
+            raise ValueError(
+                f"store has num_buckets={buckets} but config says "
+                f"{cfg.num_buckets}")
+        self.store = store
         self.localizer = Localizer(num_buckets=cfg.num_buckets,
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()

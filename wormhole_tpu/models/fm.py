@@ -1,0 +1,173 @@
+"""Factorization machine on the sharded parameter store.
+
+The BASELINE.json stretch config ("factorization-machine / wide-deep on
+Criteo — stretch param-server to TPU embedding tables"): second-order FM
+over the same hashed-bucket key space as the linear learner. Each bucket
+row holds ``[w, v_1..v_k, cg_w, cg_v1..cg_vk]`` — a weight, a k-dim latent
+factor, and their AdaGrad accumulators — so the "parameter server" is now a
+genuine sharded embedding table over the ``model`` mesh axis.
+
+Forward (Rendle 2010):  margin = Σ wᵢxᵢ + ½ Σ_f [(Σᵢ v_{if}xᵢ)² − Σᵢ v²_{if}x²ᵢ]
+
+TPU mapping: pull = one gather of the batch's unique rows; the interaction
+term is two einsums over the padded (mb, nnz, k) gathered factors (MXU
+work); the backward is ``jax.grad`` through the same expression (no
+hand-derived gradients to get wrong); push = AdaGrad + L1L2-prox on w,
+AdaGrad + weight decay on v, applied to the gathered rows and delta-
+scattered back. Same bounded-staleness driver as the linear learner
+(AsyncSGD with store=FMStore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.ops.loss import create_loss
+from wormhole_tpu.ops.metrics import accuracy, auc
+from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+
+
+@dataclass
+class FMConfig:
+    num_buckets: int = 1 << 20
+    dim: int = 8                  # latent factor size k
+    loss: str = "logit"
+    lr_alpha: float = 0.05
+    lr_beta: float = 1.0
+    l1: float = 0.0               # L1 on w (prox)
+    l2: float = 0.0               # L2 on w (prox)
+    l2_v: float = 1e-4            # weight decay on v (in-loss)
+    init_scale: float = 0.01      # v init stddev
+    seed: int = 0
+
+
+def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
+    """theta (kpad, 1+k): col 0 = w, cols 1: = v. Returns (mb,) margins."""
+    w = theta[:, 0]
+    v = theta[:, 1:]
+    lin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+    vx = v[batch.cols] * batch.vals[..., None]        # (mb, nnz, k)
+    s = jnp.sum(vx, axis=1)                           # (mb, k)
+    s2 = jnp.sum(vx * vx, axis=1)                     # (mb, k)
+    inter = 0.5 * jnp.sum(s * s - s2, axis=-1)
+    return lin + inter
+
+
+class FMStore:
+    """Sharded FM parameters + fused train/eval steps (ShardedStore
+    surface, pluggable into the AsyncSGD driver)."""
+
+    def __init__(self, cfg: FMConfig, runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.rt = runtime
+        self.objv_fn, self.dual_fn = create_loss(cfg.loss)
+        k = cfg.dim
+        rng = np.random.default_rng(cfg.seed)
+        slots = np.zeros((cfg.num_buckets, 2 * (1 + k)), np.float32)
+        # v must break symmetry; w and accumulators start at 0
+        slots[:, 1:1 + k] = (cfg.init_scale
+                             * rng.standard_normal((cfg.num_buckets, k)))
+        arr = jnp.asarray(slots)
+        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
+                and runtime.model_axis_size > 1:
+            arr = jax.device_put(
+                arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
+        self.slots = arr
+        self._step = self._build_step()
+        self._eval = self._build_eval()
+        self.t = 1
+
+    def _build_step(self):
+        cfg = self.cfg
+        k = cfg.dim
+        objv_fn = self.objv_fn
+        penalty = L1L2(cfg.l1, cfg.l2)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(slots, batch: SparseBatch, t, tau):
+            rows = slots[batch.uniq_keys]              # (kpad, 2(1+k))
+            theta, cg = rows[:, :1 + k], rows[:, 1 + k:]
+
+            def loss_fn(th):
+                margin = fm_margin(th, batch)
+                objv = objv_fn(margin, batch.labels, batch.row_mask)
+                reg = 0.5 * cfg.l2_v * jnp.sum(
+                    (th[:, 1:] * batch.key_mask[:, None]) ** 2)
+                return objv + reg, (margin, objv)
+
+            grads, (margin, objv) = jax.grad(loss_fn, has_aux=True)(theta)
+            cg_new = jnp.sqrt(cg * cg + grads * grads)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            # w: AdaGrad + L1L2 prox (same rule as AdaGradHandle);
+            # v: plain AdaGrad (decay was in the loss)
+            w_new = penalty.solve(theta[:, 0] / eta[:, 0] - grads[:, 0],
+                                  1.0 / eta[:, 0])
+            v_new = theta[:, 1:] - eta[:, 1:] * grads[:, 1:]
+            new_rows = jnp.concatenate(
+                [w_new[:, None], v_new, cg_new], axis=1)
+            delta = (new_rows - rows) * batch.key_mask[:, None]
+            slots = slots.at[batch.uniq_keys].add(delta)
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            wdelta2 = jnp.sum(delta * delta)
+            return slots, (objv, num_ex, a, acc, wdelta2)
+
+        return step
+
+    def _build_eval(self):
+        k = self.cfg.dim
+        objv_fn = self.objv_fn
+
+        @jax.jit
+        def ev(slots, batch: SparseBatch):
+            theta = slots[batch.uniq_keys][:, :1 + k]
+            margin = fm_margin(theta, batch)
+            objv = objv_fn(margin, batch.labels, batch.row_mask)
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            return objv, num_ex, a, acc, margin
+
+        return ev
+
+    # -- ShardedStore surface ------------------------------------------------
+
+    def train_step(self, batch: SparseBatch, tau: float = 0.0):
+        self.slots, metrics = self._step(
+            self.slots, batch, jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def eval_step(self, batch: SparseBatch):
+        return self._eval(self.slots, batch)
+
+    def nnz_weight(self) -> int:
+        return int(jnp.sum(self.slots[:, 0] != 0))
+
+    def save_model(self, path: str, rank: Optional[int] = None) -> None:
+        """npz of (w, v) — the embedding-table export."""
+        if rank is None:
+            rank = jax.process_index()
+        k = self.cfg.dim
+        arr = np.asarray(self.slots[:, :1 + k])
+        np.savez_compressed(f"{path}_{rank}.npz", w=arr[:, 0],
+                            v=arr[:, 1:])
+
+    def load_model(self, path: str) -> None:
+        data = np.load(path)
+        slots = np.array(self.slots)
+        slots[:, 0] = data["w"]
+        slots[:, 1:1 + self.cfg.dim] = data["v"]
+        self.slots = jax.device_put(jnp.asarray(slots),
+                                    self.slots.sharding)

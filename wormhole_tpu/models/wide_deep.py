@@ -1,0 +1,203 @@
+"""Wide & Deep on the sharded embedding table.
+
+Second BASELINE.json stretch model: the wide part is the linear term over
+hashed sparse features (the existing learner), the deep part an MLP over
+the value-weighted sum-pooled k-dim embeddings of the row's features
+(Cheng et al. 2016's dense path, field-agnostic pooled variant — our rows
+are generic hashed bags, not fixed field slots).
+
+margin(row) = Σᵢ wᵢxᵢ  +  MLP( Σᵢ xᵢ·vᵢ )
+
+Parameters:
+- sparse: one sharded ``(num_buckets, 1 + k + 1 + k)`` table
+  ``[w, v, cg_w, cg_v]`` over the ``model`` mesh axis (same layout idea as
+  the FM store);
+- dense: MLP weights, replicated, updated with AdaGrad as well.
+
+Both parts train jointly in one jitted step via ``jax.grad`` through the
+whole forward; sparse grads delta-scatter into the table, dense grads
+update in place. Pluggable into the AsyncSGD driver (store surface).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.ops.loss import create_loss
+from wormhole_tpu.ops.metrics import accuracy, auc
+from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+
+
+@dataclass
+class WideDeepConfig:
+    num_buckets: int = 1 << 20
+    dim: int = 16                      # embedding size k
+    hidden: Tuple[int, ...] = (64, 32)
+    loss: str = "logit"
+    lr_alpha: float = 0.05             # AdaGrad, sparse table
+    lr_alpha_dense: float = 0.01       # AdaGrad, MLP
+    lr_beta: float = 1.0
+    l2_v: float = 1e-5
+    init_scale: float = 0.01
+    seed: int = 0
+
+
+def init_mlp(sizes: List[int], rng: np.random.Generator):
+    """He-init MLP params as a flat dict pytree (+ AdaGrad accumulators)."""
+    params, accum = {}, {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"W{i}"] = (rng.standard_normal((a, b))
+                           * np.sqrt(2.0 / a)).astype(np.float32)
+        params[f"b{i}"] = np.zeros(b, np.float32)
+    for k, v in params.items():
+        accum[k] = np.zeros_like(v)
+    return (jax.tree.map(jnp.asarray, params),
+            jax.tree.map(jnp.asarray, accum))
+
+
+def mlp_forward(params: dict, x: jax.Array, n_layers: int) -> jax.Array:
+    h = x
+    for i in range(n_layers):
+        h = h @ params[f"W{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = jax.nn.relu(h)
+    return h[:, 0]
+
+
+class WideDeepStore:
+    """Sharded embedding table + replicated MLP, fused joint train step."""
+
+    def __init__(self, cfg: WideDeepConfig,
+                 runtime: Optional[MeshRuntime] = None):
+        self.cfg = cfg
+        self.rt = runtime
+        self.objv_fn, _ = create_loss(cfg.loss)
+        k = cfg.dim
+        rng = np.random.default_rng(cfg.seed)
+        slots = np.zeros((cfg.num_buckets, 2 * (1 + k)), np.float32)
+        slots[:, 1:1 + k] = (cfg.init_scale
+                             * rng.standard_normal((cfg.num_buckets, k)))
+        arr = jnp.asarray(slots)
+        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
+                and runtime.model_axis_size > 1:
+            arr = jax.device_put(
+                arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
+        self.slots = arr
+        sizes = [k] + list(cfg.hidden) + [1]
+        self.mlp, self.mlp_accum = init_mlp(sizes, rng)
+        self.n_layers = len(sizes) - 1
+        self._step = self._build_step()
+        self._eval = self._build_eval()
+        self.t = 1
+
+    def _forward(self, theta, mlp, batch: SparseBatch):
+        w = theta[:, 0]
+        v = theta[:, 1:]
+        wide = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+        pooled = jnp.einsum("bnk,bn->bk", v[batch.cols], batch.vals)
+        deep = mlp_forward(mlp, pooled, self.n_layers)
+        return wide + deep
+
+    def _build_step(self):
+        cfg = self.cfg
+        k = cfg.dim
+        objv_fn = self.objv_fn
+        forward = self._forward
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(slots, mlp, accum, batch: SparseBatch, t, tau):
+            rows = slots[batch.uniq_keys]
+            theta, cg = rows[:, :1 + k], rows[:, 1 + k:]
+
+            def loss_fn(th, m):
+                margin = forward(th, m, batch)
+                objv = objv_fn(margin, batch.labels, batch.row_mask)
+                reg = 0.5 * cfg.l2_v * jnp.sum(
+                    (th[:, 1:] * batch.key_mask[:, None]) ** 2)
+                return objv + reg, (margin, objv)
+
+            (g_theta, g_mlp), (margin, objv) = jax.grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(theta, mlp)
+
+            # sparse AdaGrad
+            cg_new = jnp.sqrt(cg * cg + g_theta * g_theta)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            theta_new = theta - eta * g_theta
+            new_rows = jnp.concatenate([theta_new, cg_new], axis=1)
+            delta = (new_rows - rows) * batch.key_mask[:, None]
+            slots = slots.at[batch.uniq_keys].add(delta)
+
+            # dense AdaGrad
+            accum = jax.tree.map(lambda a, g: jnp.sqrt(a * a + g * g),
+                                 accum, g_mlp)
+            mlp = jax.tree.map(
+                lambda p, g, a: p - cfg.lr_alpha_dense
+                / (cfg.lr_beta + a) * g, mlp, g_mlp, accum)
+
+            num_ex = jnp.sum(batch.row_mask)
+            a_ = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            wdelta2 = jnp.sum(delta * delta)
+            return slots, mlp, accum, (objv, num_ex, a_, acc, wdelta2)
+
+        return step
+
+    def _build_eval(self):
+        k = self.cfg.dim
+        objv_fn = self.objv_fn
+        forward = self._forward
+
+        @jax.jit
+        def ev(slots, mlp, batch: SparseBatch):
+            theta = slots[batch.uniq_keys][:, :1 + k]
+            margin = forward(theta, mlp, batch)
+            objv = objv_fn(margin, batch.labels, batch.row_mask)
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            return objv, num_ex, a, acc, margin
+
+        return ev
+
+    # -- ShardedStore surface ------------------------------------------------
+
+    def train_step(self, batch: SparseBatch, tau: float = 0.0):
+        self.slots, self.mlp, self.mlp_accum, metrics = self._step(
+            self.slots, self.mlp, self.mlp_accum, batch,
+            jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def eval_step(self, batch: SparseBatch):
+        return self._eval(self.slots, self.mlp, batch)
+
+    def nnz_weight(self) -> int:
+        return int(jnp.sum(self.slots[:, 0] != 0))
+
+    def save_model(self, path: str, rank: Optional[int] = None) -> None:
+        if rank is None:
+            rank = jax.process_index()
+        k = self.cfg.dim
+        arr = np.asarray(self.slots[:, :1 + k])
+        dense = {f"mlp_{k2}": np.asarray(v) for k2, v in self.mlp.items()}
+        np.savez_compressed(f"{path}_{rank}.npz", w=arr[:, 0],
+                            v=arr[:, 1:], **dense)
+
+    def load_model(self, path: str) -> None:
+        data = np.load(path)
+        slots = np.array(self.slots)
+        slots[:, 0] = data["w"]
+        slots[:, 1:1 + self.cfg.dim] = data["v"]
+        self.slots = jax.device_put(jnp.asarray(slots),
+                                    self.slots.sharding)
+        self.mlp = {k.replace("mlp_", ""): jnp.asarray(v)
+                    for k, v in data.items() if k.startswith("mlp_")}

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Run every app on the demo data with 8 virtual devices.
+# (reference analogue: learn/linear/guide/demo_local.sh etc.)
+set -e
+cd "$(dirname "$0")/.."
+
+python examples/make_demo_data.py
+
+LAUNCH="python -m wormhole_tpu.parallel.launcher -n 8 --cluster sim --"
+
+echo "=== async FTRL learner ==="
+$LAUNCH python -m wormhole_tpu.learners.async_sgd examples/demo.conf \
+    mesh_shape=data:2,model:4
+
+echo "=== L-BFGS linear ==="
+$LAUNCH python -m wormhole_tpu.models.linear \
+    train_data=examples/data/demo.train val_data=examples/data/demo.test \
+    reg_L2=1 max_iter=30 minibatch_size=512 model_out=/tmp/demo_lbfgs.bin \
+    mesh_shape=data:2,model:4
+
+echo "=== k-means ==="
+$LAUNCH python -m wormhole_tpu.models.kmeans \
+    data=examples/data/demo.train num_clusters=8 max_iter=10 \
+    minibatch_size=512 model_out=/tmp/demo_centroids.txt mesh_shape=data:8
+
+echo "=== GBDT ==="
+$LAUNCH python -m wormhole_tpu.models.gbdt \
+    data=examples/data/demo.train val_data=examples/data/demo.test \
+    num_round=20 max_depth=4 model_dump=/tmp/demo_gbdt.txt mesh_shape=data:8
+
+echo "=== text2rec roundtrip ==="
+python -m wormhole_tpu.tools.text2rec input=examples/data/demo.train \
+    output=/tmp/demo.rec format=libsvm
+python -m wormhole_tpu.tools.print_rec input=/tmp/demo.rec limit=3
+
+echo "ALL DEMOS OK"

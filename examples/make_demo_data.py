@@ -1,0 +1,36 @@
+"""Generate the demo dataset (reference analogue: learn/data/agaricus —
+we generate a synthetic binary-classification set instead of bundling it).
+
+Creates examples/data/demo.{train,test} in libsvm format: 127 binary
+features, labels from a sparse ground-truth rule + noise — shaped like the
+mushroom data (one-hot categoricals, separable but not trivially).
+"""
+
+import os
+
+import numpy as np
+
+
+def main(n_train=2000, n_test=500, f=127, seed=42):
+    rng = np.random.default_rng(seed)
+    w = np.zeros(f)
+    active = rng.choice(f, size=20, replace=False)
+    w[active] = rng.standard_normal(20) * 2
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    os.makedirs(here, exist_ok=True)
+    for name, n in (("demo.train", n_train), ("demo.test", n_test)):
+        lines = []
+        for _ in range(n):
+            nnz = rng.integers(8, 24)
+            idx = np.sort(rng.choice(f, size=nnz, replace=False))
+            margin = w[idx].sum() + 0.3 * rng.standard_normal()
+            y = int(margin > 0)
+            lines.append(f"{y} " + " ".join(f"{j}:1" for j in idx))
+        path = os.path.join(here, name)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        print(f"wrote {path} ({n} rows)")
+
+
+if __name__ == "__main__":
+    main()

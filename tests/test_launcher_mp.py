@@ -1,0 +1,81 @@
+"""Launcher multi-process mode: real jax.distributed over localhost (the
+DCN code path the reference exercises with dmlc_local.py multi-process
+runs, SURVEY.md §4.3)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_mp(n: int, body: str, timeout=240) -> str:
+    script = os.path.join(REPO, ".pytest_cache", f"mp_body_{os.getpid()}.py")
+    os.makedirs(os.path.dirname(script), exist_ok=True)
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(body))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}  # children get their own device count
+    r = subprocess.run(
+        [sys.executable, "-m", "wormhole_tpu.parallel.launcher",
+         "-n", str(n), "--cluster", "mp", "--", sys.executable, script],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_mp_collectives():
+    out = run_mp(2, """
+        from wormhole_tpu.parallel.mesh import MeshRuntime
+        import numpy as np
+        rt = MeshRuntime.create()
+        assert rt.world == 2, rt.world
+        from wormhole_tpu.parallel.collectives import (allreduce_tree,
+                                                       broadcast_tree)
+        total = allreduce_tree(np.asarray(float(rt.rank + 1)),
+                               rt.mesh, "sum")
+        assert float(total) == 3.0, total
+        mx = allreduce_tree(np.asarray(float(rt.rank)), rt.mesh, "max")
+        assert float(mx) == 1.0, mx
+        root = broadcast_tree(
+            np.asarray(42.0 if rt.rank == 0 else -1.0), rt.mesh)
+        assert float(root) == 42.0, root
+        print(f"OK rank {rt.rank}")
+    """)
+    assert out.count("OK rank") == 2
+
+
+def test_mp_kmeans_two_hosts(tmp_path):
+    """Each process reads its shard (rank/world), stats allreduce across
+    processes — the reference's multi-node-without-a-cluster test."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((3, 12))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    lines = []
+    for i in range(240):
+        x = centers[i % 3] + 0.05 * rng.standard_normal(12)
+        feats = " ".join(f"{j}:{x[j]:.5g}" for j in range(12))
+        lines.append(f"0 {feats}")
+    data = tmp_path / "km.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+
+    out = run_mp(2, f"""
+        from wormhole_tpu.models.kmeans import KMeans, KMeansConfig
+        from wormhole_tpu.parallel.mesh import MeshRuntime
+        rt = MeshRuntime.create()
+        km = KMeans(KMeansConfig(num_clusters=3, max_iter=6,
+                                 minibatch_size=64), rt)
+        batches = km.load_batches({str(data)!r})
+        km.fit(batches)
+        assert km.history[-1] < 0.05, km.history
+        print(f"OK rank {{rt.rank}} objv={{km.history[-1]:.4f}}")
+    """)
+    assert out.count("OK rank") == 2
+    # both processes converged to the same global objective
+    objvs = {ln.split("objv=")[1] for ln in out.splitlines()
+             if "objv=" in ln}
+    assert len(objvs) == 1, out

@@ -31,7 +31,21 @@ class LoadedBatches:
 
 def dense_batch_sharding(rt: MeshRuntime):
     """Batch dim over ``data``, trailing dims replicated (a short
-    PartitionSpec covers all leaf ranks); None when unsharded."""
+    PartitionSpec covers all leaf ranks); None when unsharded.
+
+    Multi-process: batches are HOST-LOCAL (each process reads its own
+    rank/world input shard — different data per host), so they shard over
+    the process's *local* devices only; the cross-host reduction happens at
+    the host-collective level (allreduce_tree), exactly the reference's
+    per-rank data + Allreduce model. A global-mesh sharding here would
+    demand identical values on every process."""
+    if jax.process_count() > 1:
+        local = jax.local_devices()
+        if len(local) == 1:
+            return None
+        from jax.sharding import Mesh
+        return NamedSharding(Mesh(np.asarray(local), (DATA_AXIS,)),
+                             P(DATA_AXIS))
     if DATA_AXIS not in rt.mesh.axis_names or rt.data_axis_size == 1:
         return None
     return NamedSharding(rt.mesh, P(DATA_AXIS))

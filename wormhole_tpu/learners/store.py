@@ -38,6 +38,22 @@ from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
 
 
+def shard_param_table(arr: jax.Array,
+                      runtime: Optional[MeshRuntime]) -> jax.Array:
+    """Place a (num_buckets, val_len) parameter table over the ``model``
+    mesh axis (validating divisibility), or leave it on the default device.
+    Shared by ShardedStore / FMStore / WideDeepStore."""
+    if runtime is None or MODEL_AXIS not in runtime.mesh.axis_names \
+            or runtime.model_axis_size <= 1:
+        return arr
+    if arr.shape[0] % runtime.model_axis_size:
+        raise ValueError(
+            f"num_buckets {arr.shape[0]} not divisible by model axis "
+            f"{runtime.model_axis_size}")
+    return jax.device_put(
+        arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
+
+
 def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
     """Symmetric fixed-point round-trip (FIXING_FLOAT filter semantics:
     lossy fixed-byte compression of values in transit)."""
@@ -64,16 +80,8 @@ class ShardedStore:
         self.handle = handle
         self.rt = runtime
         self.objv_fn, self.dual_fn = create_loss(cfg.loss)
-        slots = handle.init(cfg.num_buckets)
-        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
-                and runtime.model_axis_size > 1:
-            if cfg.num_buckets % runtime.model_axis_size:
-                raise ValueError(
-                    f"num_buckets {cfg.num_buckets} not divisible by model "
-                    f"axis {runtime.model_axis_size}")
-            slots = jax.device_put(
-                slots, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
-        self.slots = slots
+        self.slots = shard_param_table(handle.init(cfg.num_buckets),
+                                       runtime)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self.t = 1  # global update counter (SGD eta schedule)

@@ -76,12 +76,8 @@ class FMStore:
         # v must break symmetry; w and accumulators start at 0
         slots[:, 1:1 + k] = (cfg.init_scale
                              * rng.standard_normal((cfg.num_buckets, k)))
-        arr = jnp.asarray(slots)
-        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
-                and runtime.model_axis_size > 1:
-            arr = jax.device_put(
-                arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
-        self.slots = arr
+        from wormhole_tpu.learners.store import shard_param_table
+        self.slots = shard_param_table(jnp.asarray(slots), runtime)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self.t = 1
@@ -119,7 +115,8 @@ class FMStore:
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)
             acc = accuracy(batch.labels, margin, batch.row_mask)
-            wdelta2 = jnp.sum(delta * delta)
+            # w column only — comparable with the linear store's metric
+            wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
             return slots, (objv, num_ex, a, acc, wdelta2)
 
         return step

@@ -149,32 +149,55 @@ class KMeans:
     # -- init ---------------------------------------------------------------
 
     def init_centroids(self, batches: List[DenseBatch]) -> KMeansState:
-        """Pick K random real rows as initial centroids (reference
-        InitCentroids, kmeans.cc:92-109: random rows, broadcast from a random
-        proc). Multi-host: rank 0's choice is broadcast via the host
-        collective."""
+        """Farthest-point init over a random candidate pool (upgrade of the
+        reference's random-row InitCentroids, kmeans.cc:92-109, which can
+        collapse two centroids into one blob): sample up to 16·K real rows,
+        pick the first at random, then greedily take the candidate least
+        similar (max cosine) to everything chosen. Multi-host: rank 0's
+        choice is broadcast via the host collective (the reference
+        broadcasts each row from a random proc)."""
         k, f = self.cfg.num_clusters, self.cfg.num_features
         rng = np.random.default_rng(self.cfg.seed)
-        cent = np.zeros((k, f), np.float32)
-        picked = 0
+        pool: List[np.ndarray] = []
+        # candidate pool capped at ~200 MB of host floats for huge F
+        want = min(16 * k, max(k, int(5e7 / max(f, 1))))
         order = rng.permutation(len(batches)) if batches else []
         for bi in order:
             b = batches[bi]
             cols = np.asarray(b.cols)
             vals = np.asarray(b.vals)
-            mask = np.asarray(b.row_mask)
-            rows = np.nonzero(mask > 0)[0]
+            rows = np.nonzero(np.asarray(b.row_mask) > 0)[0]
             rng.shuffle(rows)
             for r in rows:
-                if picked == k:
+                if len(pool) >= want:
                     break
+                dense = np.zeros(f, np.float32)
                 real = vals[r] != 0  # skip padding (col 0 / val 0) entries
-                np.add.at(cent[picked], cols[r][real], vals[r][real])
-                picked += 1
-            if picked == k:
+                np.add.at(dense, cols[r][real], vals[r][real])
+                norm = np.linalg.norm(dense)
+                if norm > 1e-12:
+                    pool.append(dense / norm)
+            if len(pool) >= want:
                 break
-        if picked < k:
-            cent[picked:] = rng.standard_normal((k - picked, f)) * 0.01
+        cent = np.zeros((k, f), np.float32)
+        n_have = 0
+        if pool:
+            cand = np.stack(pool)                    # (m, f) unit rows
+            first = int(rng.integers(len(cand)))
+            chosen = [first]
+            sim = cand @ cand[first]                 # max cos to chosen set
+            sim[first] = np.inf                      # never re-pick
+            while len(chosen) < min(k, len(cand)):
+                nxt = int(np.argmin(sim))
+                if not np.isfinite(sim[nxt]):
+                    break  # only exact duplicates remain
+                chosen.append(nxt)
+                sim = np.maximum(sim, cand @ cand[nxt])
+                sim[nxt] = np.inf
+            cent[:len(chosen)] = cand[chosen]
+            n_have = len(chosen)
+        if n_have < k:
+            cent[n_have:] = rng.standard_normal((k - n_have, f)) * 0.01
         from wormhole_tpu.parallel.collectives import broadcast_tree
         cent = broadcast_tree(cent, self.rt.mesh, root=0)
         state = KMeansState(

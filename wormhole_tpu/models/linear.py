@@ -181,6 +181,12 @@ class LinearLBFGS:
         return dense_batch_sharding(self.rt)
 
     def _w_sharding(self):
+        # Multi-process: batches are host-local (data/loader.py), so w must
+        # be too — cross-host reduction happens in LinearObjective's host
+        # allreduce, not via a global-mesh sharding (which would put w and
+        # the batches on incompatible device sets inside one jit).
+        if jax.process_count() > 1:
+            return None
         mesh = self.rt.mesh
         if MODEL_AXIS in mesh.axis_names and self.rt.model_axis_size > 1:
             return NamedSharding(mesh, P(MODEL_AXIS))

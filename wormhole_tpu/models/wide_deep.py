@@ -85,12 +85,8 @@ class WideDeepStore:
         slots = np.zeros((cfg.num_buckets, 2 * (1 + k)), np.float32)
         slots[:, 1:1 + k] = (cfg.init_scale
                              * rng.standard_normal((cfg.num_buckets, k)))
-        arr = jnp.asarray(slots)
-        if runtime is not None and MODEL_AXIS in runtime.mesh.axis_names \
-                and runtime.model_axis_size > 1:
-            arr = jax.device_put(
-                arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
-        self.slots = arr
+        from wormhole_tpu.learners.store import shard_param_table
+        self.slots = shard_param_table(jnp.asarray(slots), runtime)
         sizes = [k] + list(cfg.hidden) + [1]
         self.mlp, self.mlp_accum = init_mlp(sizes, rng)
         self.n_layers = len(sizes) - 1
@@ -145,7 +141,8 @@ class WideDeepStore:
             num_ex = jnp.sum(batch.row_mask)
             a_ = auc(batch.labels, margin, batch.row_mask)
             acc = accuracy(batch.labels, margin, batch.row_mask)
-            wdelta2 = jnp.sum(delta * delta)
+            # w column only — comparable with the linear store's metric
+            wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
             return slots, mlp, accum, (objv, num_ex, a_, acc, wdelta2)
 
         return step

@@ -172,3 +172,39 @@ def test_divergence_kill_switch(rng, tmp_path):
     app = AsyncSGD(cfg, MeshRuntime.create())
     with pytest.raises(DivergedError):
         app.run()
+
+
+def test_checkpoint_restart_resumes(rng, tmp_path):
+    """Kill after pass 2 of 4; a fresh driver resumes at pass 2 and ends
+    with the same weights as an uninterrupted run (optimizer accumulators
+    included — FTRL z/cg must survive)."""
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, rng, n=300, f=50)
+    base = dict(train_data=path, algo=Algo.FTRL, minibatch=50,
+                num_buckets=NB, fixed_bytes=0, disp_itv=1e9)
+    full = AsyncSGD(Config(**base, max_data_pass=4), MeshRuntime.create())
+    full.run()
+    w_full = full.store.pull(np.arange(NB))
+
+    ckdir = str(tmp_path / "ck")
+    half = AsyncSGD(Config(**base, max_data_pass=2, checkpoint_dir=ckdir),
+                    MeshRuntime.create())
+    half.run()
+    resumed = AsyncSGD(Config(**base, max_data_pass=4,
+                              checkpoint_dir=ckdir), MeshRuntime.create())
+    resumed.run()
+    np.testing.assert_allclose(resumed.store.pull(np.arange(NB)), w_full,
+                               atol=1e-6)
+
+
+def test_pipeline_profile_collected(rng, tmp_path):
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, rng, n=100, f=30)
+    app = AsyncSGD(Config(train_data=path, minibatch=50, max_data_pass=1,
+                          num_buckets=NB, disp_itv=1e9),
+                   MeshRuntime.create())
+    app.run()
+    for stage in ("parse", "localize", "pad", "dispatch", "wait"):
+        assert stage in app.timer.totals, app.timer.totals

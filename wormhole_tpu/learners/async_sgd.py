@@ -40,6 +40,7 @@ from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
 from wormhole_tpu.utils.config import Config
 from wormhole_tpu.utils.logging import get_logger
 from wormhole_tpu.utils.progress import Progress
+from wormhole_tpu.utils.timer import Timer
 
 log = get_logger("async_sgd")
 
@@ -88,6 +89,10 @@ class AsyncSGD:
         self._max_nnz = cfg.max_nnz
         self._warned_trunc = False
         self._last_nnz = 0  # model nnz sampled at pass boundaries only
+        self.timer = Timer()  # pipeline stage profile (SURVEY §5.1)
+        from wormhole_tpu.parallel.checkpoint import Checkpointer
+        self.ckpt = Checkpointer(cfg.checkpoint_dir)
+        self._warned_ckpt = False
 
     # -- worker data path ---------------------------------------------------
 
@@ -96,8 +101,14 @@ class AsyncSGD:
         cfg = self.cfg
         reader = MinibatchIter(file, part, nparts, cfg.data_format,
                                cfg.minibatch)
-        for blk in reader:
-            loc = self.localizer.localize(blk)
+        it = iter(reader)
+        while True:
+            with self.timer.scope("parse"):
+                blk = next(it, None)
+            if blk is None:
+                break
+            with self.timer.scope("localize"):
+                loc = self.localizer.localize(blk)
             # per-batch nnz bucket, monotone so shapes don't thrash; a denser
             # later batch grows the bucket (one recompile) up to the 4096-
             # entry cap — rows beyond the cap (or beyond a user-set
@@ -111,7 +122,10 @@ class AsyncSGD:
                     "row with %d features truncated to max_nnz=%d "
                     "(set max_nnz to keep more)", densest, self._max_nnz)
             kpad = next_bucket(len(loc.uniq_keys), 64)
-            yield pad_to_batch(loc, cfg.minibatch, self._max_nnz, kpad)
+            with self.timer.scope("pad"):
+                batch = pad_to_batch(loc, cfg.minibatch, self._max_nnz,
+                                     kpad)
+            yield batch
 
     def process(self, file: str, part: int, nparts: int,
                 kind: str = TRAIN) -> Progress:
@@ -135,15 +149,19 @@ class AsyncSGD:
                 self._display(local)
 
         for batch in self._batches(file, part, nparts):
-            while len(inflight) > max_delay:       # WaitMinibatch(max_delay)
-                harvest(jax.block_until_ready(inflight.popleft()))
-            if kind == TRAIN:
-                m = self.store.train_step(batch, tau=float(len(inflight)))
-            else:
-                m = self.store.eval_step(batch)[:4]
+            with self.timer.scope("wait"):         # WaitMinibatch(max_delay)
+                while len(inflight) > max_delay:
+                    harvest(jax.block_until_ready(inflight.popleft()))
+            with self.timer.scope("dispatch"):
+                if kind == TRAIN:
+                    m = self.store.train_step(batch,
+                                              tau=float(len(inflight)))
+                else:
+                    m = self.store.eval_step(batch)[:4]
             inflight.append(m)
-        while inflight:                            # WaitMinibatch(0)
-            harvest(jax.block_until_ready(inflight.popleft()))
+        with self.timer.scope("wait"):             # WaitMinibatch(0)
+            while inflight:
+                harvest(jax.block_until_ready(inflight.popleft()))
         return local
 
     # -- scheduler loop -----------------------------------------------------
@@ -153,7 +171,25 @@ class AsyncSGD:
         cfg = self.cfg
         worker = f"proc{self.rt.rank}"
         print(Progress.HEADER)
-        for data_pass in range(cfg.max_data_pass):
+        # checkpoint resume at pass granularity (rabit LoadCheckPoint
+        # semantics: version = completed data passes). The reference's
+        # async model dies with a server; here the whole sharded state —
+        # including optimizer accumulators — survives a restart.
+        start_pass = 0
+        if cfg.checkpoint_dir and self._ckpt_ok():
+            start_pass, state = self.ckpt.load(self.store.state_pytree())
+            if jax.process_count() > 1:
+                # ranks must agree on the resume point even when the
+                # checkpoint dir is not shared: rank 0's view wins
+                from wormhole_tpu.parallel.collectives import broadcast_tree
+                start_pass = int(broadcast_tree(np.int64(start_pass),
+                                                self.rt.mesh))
+                state = broadcast_tree(
+                    jax.tree.map(np.asarray, state), self.rt.mesh)
+            if start_pass:
+                self.store.restore_pytree(state)
+                log.info("resumed at data pass %d", start_pass)
+        for data_pass in range(start_pass, cfg.max_data_pass):
             self.pool.clear()
             self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
             while True:
@@ -165,6 +201,8 @@ class AsyncSGD:
                 self.pool.finish(wl.id)
                 self._check_divergence(prog)
             self._last_nnz = self.store.nnz_weight()
+            if cfg.checkpoint_dir and self._ckpt_ok():
+                self.ckpt.save(data_pass + 1, self.store.state_pytree())
             if cfg.val_data:
                 vp = self._run_eval(cfg.val_data)
                 n = max(vp.num_ex, 1)
@@ -173,7 +211,25 @@ class AsyncSGD:
                          vp.acc / max(vp.count, 1))
         if cfg.model_out:
             self.store.save_model(cfg.model_out, self.rt.rank)
+        if self.timer.totals:
+            log.info("pipeline profile:\n%s", self.timer.report())
         return self.progress
+
+    def _ckpt_ok(self) -> bool:
+        """Checkpointing requires fully host-addressable state: parameter
+        tables sharded ACROSS processes can't be serialized by a rank-0
+        writer (Checkpointer contract). Skip loudly rather than crash."""
+        if not hasattr(self.store, "state_pytree"):
+            return False
+        leaves = jax.tree.leaves(self.store.state_pytree())
+        ok = all(getattr(x, "is_fully_addressable", True) for x in leaves)
+        if not ok and not self._warned_ckpt:
+            self._warned_ckpt = True
+            log.warning(
+                "checkpointing skipped: store state is sharded across "
+                "processes (not rank-0 addressable); use per-host model "
+                "export (model_out) instead")
+        return ok
 
     def _run_eval(self, pattern: str) -> Progress:
         pool = WorkloadPool()

@@ -71,7 +71,21 @@ class StoreConfig:
     lr_theta: float = 1.0     # staleness weight for DT handles
 
 
-class ShardedStore:
+class TableCheckpoint:
+    """Checkpointable {slots, t} state shared by the table-backed stores
+    (rabit Serializable analogue). Stores with extra state (wide&deep's
+    MLP) extend the pytree."""
+
+    def state_pytree(self):
+        return {"slots": self.slots, "t": np.int64(self.t)}
+
+    def restore_pytree(self, state) -> None:
+        self.slots = jax.device_put(jnp.asarray(state["slots"]),
+                                    self.slots.sharding)
+        self.t = int(state["t"])
+
+
+class ShardedStore(TableCheckpoint):
     """Model state + the fused pull→forward→backward→push step."""
 
     def __init__(self, cfg: StoreConfig, handle: Handle,

@@ -27,13 +27,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.ops.penalty import L1L2
-from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+from wormhole_tpu.parallel.mesh import MeshRuntime
 
 
 @dataclass
@@ -62,7 +60,10 @@ def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
     return lin + inter
 
 
-class FMStore:
+from wormhole_tpu.learners.store import TableCheckpoint
+
+
+class FMStore(TableCheckpoint):
     """Sharded FM parameters + fused train/eval steps (ShardedStore
     surface, pluggable into the AsyncSGD driver)."""
 

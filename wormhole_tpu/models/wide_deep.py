@@ -28,12 +28,10 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
-from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+from wormhole_tpu.parallel.mesh import MeshRuntime
 
 
 @dataclass
@@ -72,7 +70,10 @@ def mlp_forward(params: dict, x: jax.Array, n_layers: int) -> jax.Array:
     return h[:, 0]
 
 
-class WideDeepStore:
+from wormhole_tpu.learners.store import TableCheckpoint
+
+
+class WideDeepStore(TableCheckpoint):
     """Sharded embedding table + replicated MLP, fused joint train step."""
 
     def __init__(self, cfg: WideDeepConfig,
@@ -179,6 +180,16 @@ class WideDeepStore:
 
     def nnz_weight(self) -> int:
         return int(jnp.sum(self.slots[:, 0] != 0))
+
+    def state_pytree(self):
+        base = super().state_pytree()
+        base.update(mlp=self.mlp, accum=self.mlp_accum)
+        return base
+
+    def restore_pytree(self, state) -> None:
+        super().restore_pytree(state)
+        self.mlp = jax.tree.map(jnp.asarray, state["mlp"])
+        self.mlp_accum = jax.tree.map(jnp.asarray, state["accum"])
 
     def save_model(self, path: str, rank: Optional[int] = None) -> None:
         if rank is None:

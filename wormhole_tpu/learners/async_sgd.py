@@ -96,18 +96,19 @@ class AsyncSGD:
 
     # -- worker data path ---------------------------------------------------
 
-    def _batches(self, file: str, part: int, nparts: int):
+    def _batches(self, file: str, part: int, nparts: int,
+                 prefix: str = ""):
         """stream → localize → pad, with shape bucketing for XLA."""
         cfg = self.cfg
         reader = MinibatchIter(file, part, nparts, cfg.data_format,
                                cfg.minibatch)
         it = iter(reader)
         while True:
-            with self.timer.scope("parse"):
+            with self.timer.scope(prefix + "parse"):
                 blk = next(it, None)
             if blk is None:
                 break
-            with self.timer.scope("localize"):
+            with self.timer.scope(prefix + "localize"):
                 loc = self.localizer.localize(blk)
             # per-batch nnz bucket, monotone so shapes don't thrash; a denser
             # later batch grows the bucket (one recompile) up to the 4096-
@@ -122,7 +123,7 @@ class AsyncSGD:
                     "row with %d features truncated to max_nnz=%d "
                     "(set max_nnz to keep more)", densest, self._max_nnz)
             kpad = next_bucket(len(loc.uniq_keys), 64)
-            with self.timer.scope("pad"):
+            with self.timer.scope(prefix + "pad"):
                 batch = pad_to_batch(loc, cfg.minibatch, self._max_nnz,
                                      kpad)
             yield batch
@@ -148,18 +149,21 @@ class AsyncSGD:
             if kind == TRAIN:  # eval metrics must not pollute train rows
                 self._display(local)
 
-        for batch in self._batches(file, part, nparts):
-            with self.timer.scope("wait"):         # WaitMinibatch(max_delay)
+        # eval records under its own prefix so the training pipeline
+        # profile (the thing SURVEY §5.1 wants) stays unskewed
+        pfx = "" if kind == TRAIN else "eval_"
+        for batch in self._batches(file, part, nparts, pfx):
+            with self.timer.scope(pfx + "wait"):   # WaitMinibatch(max_delay)
                 while len(inflight) > max_delay:
                     harvest(jax.block_until_ready(inflight.popleft()))
-            with self.timer.scope("dispatch"):
+            with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
                     m = self.store.train_step(batch,
                                               tau=float(len(inflight)))
                 else:
                     m = self.store.eval_step(batch)[:4]
             inflight.append(m)
-        with self.timer.scope("wait"):             # WaitMinibatch(0)
+        with self.timer.scope(pfx + "wait"):       # WaitMinibatch(0)
             while inflight:
                 harvest(jax.block_until_ready(inflight.popleft()))
         return local
@@ -180,12 +184,15 @@ class AsyncSGD:
             start_pass, state = self.ckpt.load(self.store.state_pytree())
             if jax.process_count() > 1:
                 # ranks must agree on the resume point even when the
-                # checkpoint dir is not shared: rank 0's view wins
+                # checkpoint dir is not shared: rank 0's view wins. The
+                # scalar broadcast goes first so the (large) state is only
+                # shipped when there is actually something to resume.
                 from wormhole_tpu.parallel.collectives import broadcast_tree
                 start_pass = int(broadcast_tree(np.int64(start_pass),
                                                 self.rt.mesh))
-                state = broadcast_tree(
-                    jax.tree.map(np.asarray, state), self.rt.mesh)
+                if start_pass:
+                    state = broadcast_tree(
+                        jax.tree.map(np.asarray, state), self.rt.mesh)
             if start_pass:
                 self.store.restore_pytree(state)
                 log.info("resumed at data pass %d", start_pass)
@@ -220,6 +227,11 @@ class AsyncSGD:
         tables sharded ACROSS processes can't be serialized by a rank-0
         writer (Checkpointer contract). Skip loudly rather than crash."""
         if not hasattr(self.store, "state_pytree"):
+            if not self._warned_ckpt:
+                self._warned_ckpt = True
+                log.warning(
+                    "checkpointing skipped: store %s has no state_pytree",
+                    type(self.store).__name__)
             return False
         leaves = jax.tree.leaves(self.store.state_pytree())
         ok = all(getattr(x, "is_fully_addressable", True) for x in leaves)

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.learners.store import (TableCheckpoint,
+                                          shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.ops.penalty import L1L2
@@ -60,9 +62,6 @@ def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
     return lin + inter
 
 
-from wormhole_tpu.learners.store import TableCheckpoint
-
-
 class FMStore(TableCheckpoint):
     """Sharded FM parameters + fused train/eval steps (ShardedStore
     surface, pluggable into the AsyncSGD driver)."""
@@ -77,7 +76,6 @@ class FMStore(TableCheckpoint):
         # v must break symmetry; w and accumulators start at 0
         slots[:, 1:1 + k] = (cfg.init_scale
                              * rng.standard_normal((cfg.num_buckets, k)))
-        from wormhole_tpu.learners.store import shard_param_table
         self.slots = shard_param_table(jnp.asarray(slots), runtime)
         self._step = self._build_step()
         self._eval = self._build_eval()

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.learners.store import (TableCheckpoint,
+                                          shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.parallel.mesh import MeshRuntime
@@ -70,9 +72,6 @@ def mlp_forward(params: dict, x: jax.Array, n_layers: int) -> jax.Array:
     return h[:, 0]
 
 
-from wormhole_tpu.learners.store import TableCheckpoint
-
-
 class WideDeepStore(TableCheckpoint):
     """Sharded embedding table + replicated MLP, fused joint train step."""
 
@@ -86,7 +85,6 @@ class WideDeepStore(TableCheckpoint):
         slots = np.zeros((cfg.num_buckets, 2 * (1 + k)), np.float32)
         slots[:, 1:1 + k] = (cfg.init_scale
                              * rng.standard_normal((cfg.num_buckets, k)))
-        from wormhole_tpu.learners.store import shard_param_table
         self.slots = shard_param_table(jnp.asarray(slots), runtime)
         sizes = [k] + list(cfg.hidden) + [1]
         self.mlp, self.mlp_accum = init_mlp(sizes, rng)

@@ -35,7 +35,7 @@ from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.learners.handles import LearnRate, create_handle
 from wormhole_tpu.learners.store import ShardedStore, StoreConfig
 from wormhole_tpu.ops.penalty import L1L2
-from wormhole_tpu.parallel.mesh import MeshRuntime
+from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
 from wormhole_tpu.utils.config import Config
 from wormhole_tpu.utils.logging import get_logger
@@ -122,7 +122,8 @@ class AsyncSGD:
                 log.warning(
                     "row with %d features truncated to max_nnz=%d "
                     "(set max_nnz to keep more)", densest, self._max_nnz)
-            kpad = next_bucket(len(loc.uniq_keys), 64)
+            kpad = (self.cfg.key_pad
+                    or next_bucket(len(loc.uniq_keys), 64))
             with self.timer.scope(prefix + "pad"):
                 batch = pad_to_batch(loc, cfg.minibatch, self._max_nnz,
                                      kpad)
@@ -172,6 +173,8 @@ class AsyncSGD:
 
     def run(self) -> Progress:
         """Pass/workload loop (AsyncSGDScheduler::Run, async_sgd.h:294-348)."""
+        if jax.process_count() > 1:
+            return self.run_multihost()
         cfg = self.cfg
         worker = f"proc{self.rt.rank}"
         print(Progress.HEADER)
@@ -220,6 +223,98 @@ class AsyncSGD:
             self.store.save_model(cfg.model_out, self.rt.rank)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
+        return self.progress
+
+    # -- multi-host synchronized training -----------------------------------
+    #
+    # The reference scales the async learner by adding worker/server
+    # processes with no global barrier. The SPMD equivalent: every host
+    # builds its LOCAL batch (own workload shard, own unique-key set), the
+    # batches are assembled into ONE global batch — rows and key segments
+    # sharded over the ``data`` axis, cols offset into the host's key
+    # segment — and the same fused step runs globally: the slots
+    # gather/scatter against the model-axis-sharded table IS the
+    # distributed pull/push (XLA emits the collectives). Buckets touched by
+    # several hosts accumulate each host's delta computed from the same
+    # pre-step state — exactly the reference's async-apply semantics.
+    # Shapes must match across hosts, so max_nnz and key_pad are required
+    # static config here.
+
+    def _global_batch(self, batch):
+        """Assemble per-host batches into one data-axis-sharded batch."""
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+        from wormhole_tpu.data.feed import SparseBatch
+        kpad = self.cfg.key_pad
+        batch = SparseBatch(
+            cols=batch.cols + np.int32(self.rt.rank * kpad),
+            vals=batch.vals, labels=batch.labels, row_mask=batch.row_mask,
+            uniq_keys=batch.uniq_keys, key_mask=batch.key_mask)
+        return multihost_utils.host_local_array_to_global_array(
+            batch, self.rt.mesh, P(DATA_AXIS))
+
+    def _empty_local_batch(self):
+        from wormhole_tpu.data.feed import SparseBatch
+        cfg = self.cfg
+        return SparseBatch(
+            cols=np.zeros((cfg.minibatch, cfg.max_nnz), np.int32),
+            vals=np.zeros((cfg.minibatch, cfg.max_nnz), np.float32),
+            labels=np.zeros(cfg.minibatch, np.float32),
+            row_mask=np.zeros(cfg.minibatch, np.float32),
+            uniq_keys=np.zeros(cfg.key_pad, np.int32),
+            key_mask=np.zeros(cfg.key_pad, np.float32))
+
+    def run_multihost(self) -> Progress:
+        """Synchronized multi-host passes: static rank/world partition of
+        every matched file; hosts that exhaust their shard first feed
+        masked empty batches until everyone is done (the per-step
+        have-data allreduce keeps the collectives aligned)."""
+        from wormhole_tpu.data.stream import list_files
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        if not (cfg.max_nnz and cfg.key_pad):
+            raise ValueError("multi-host sync training needs static "
+                             "max_nnz= and key_pad= config")
+        self._max_nnz = cfg.max_nnz
+        files = [fi.path for fi in list_files(cfg.train_data)]
+        if not files:
+            raise FileNotFoundError(cfg.train_data)
+        print(Progress.HEADER)
+        local = Progress()
+
+        def harvest(metrics):
+            vals = [float(np.asarray(m)) for m in metrics]
+            local.objv += vals[0]
+            local.num_ex += int(vals[1])
+            local.count += 1
+            local.auc += vals[2]
+            local.acc += vals[3]
+            self._display(local)
+
+        inflight: deque = deque()
+        for _ in range(cfg.max_data_pass):
+            def local_batches():
+                for f in files:
+                    yield from self._batches(f, self.rt.rank,
+                                             self.rt.world)
+            it = local_batches()
+            while True:
+                blk = next(it, None)
+                have = int(allreduce_tree(np.int64(blk is not None),
+                                          self.rt.mesh, "sum"))
+                if have == 0:
+                    break
+                batch = self._global_batch(
+                    blk if blk is not None else self._empty_local_batch())
+                while len(inflight) > cfg.max_delay:
+                    harvest(jax.block_until_ready(inflight.popleft()))
+                inflight.append(
+                    self.store.train_step(batch, tau=float(len(inflight))))
+            while inflight:
+                harvest(jax.block_until_ready(inflight.popleft()))
+        self.progress.merge(local)
+        if cfg.model_out:
+            self.store.save_model(cfg.model_out, self.rt.rank)
         return self.progress
 
     def _ckpt_ok(self) -> bool:

@@ -99,6 +99,9 @@ class Config:
     # --- TPU runtime (new; no reference analogue) ---
     num_buckets: int = 1 << 20  # hashed parameter-bucket count (FLAGS_max_key analogue)
     max_nnz: int = 0            # 0 = derive from data; per-row padded nnz
+    key_pad: int = 0            # static unique-key padding; REQUIRED (with
+                                # max_nnz) for multi-host sync training,
+                                # where batch shapes must match across hosts
     mesh_shape: str = ""        # e.g. "data:4,model:2"; empty = all devices on "data"
     param_dtype: str = "float32"
     seed: int = 0

@@ -94,3 +94,39 @@ def test_accuracy_and_logloss():
     # logloss of a confident-correct pair is small, wrong pair large
     ll = float(logloss(y, m, mask))
     assert 0.5 < ll < 1.5
+
+
+def test_auc_weighted_mann_whitney(rng):
+    """row_mask carries fractional example weights (feed.py); the AUC must
+    be the weighted Mann-Whitney statistic, exact for non-binary weights."""
+    n = 64
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    m = rng.normal(size=n).astype(np.float32)
+    w = rng.random(n).astype(np.float32) + 0.1
+    # brute-force weighted AUC: sum over (pos, neg) pairs of wp*wn*[mp > mn]
+    num = den = 0.0
+    for i in range(n):
+        for j in range(n):
+            if y[i] > 0.5 and y[j] <= 0.5:
+                den += w[i] * w[j]
+                if m[i] > m[j]:
+                    num += w[i] * w[j]
+    expect = num / den
+    got = float(auc(jnp.asarray(y), jnp.asarray(m), jnp.asarray(w)))
+    assert got == pytest.approx(expect, abs=1e-5)
+    # host pooled version agrees
+    from wormhole_tpu.ops.metrics import auc_np
+    assert auc_np(y, m, w) == pytest.approx(expect, abs=1e-6)
+
+
+def test_hinge_loss_gradient():
+    """hinge: objv = Σ max(0, 1-y·m); dual = -y on violated margins."""
+    from wormhole_tpu.ops.loss import create_loss
+    objv_fn, dual_fn = create_loss("hinge")
+    m = jnp.asarray([0.5, 2.0, -0.5, -2.0])
+    y = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    mask = jnp.ones(4)
+    # y=+1: margins .5 (viol, loss .5), 2.0 (ok); y=-1: -0.5 (viol, .5), -2 ok
+    assert float(objv_fn(m, y, mask)) == pytest.approx(1.0)
+    np.testing.assert_allclose(np.asarray(dual_fn(m, y, mask)),
+                               [-1.0, 0.0, 1.0, 0.0])

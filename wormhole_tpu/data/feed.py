@@ -112,8 +112,13 @@ def pad_to_batch(loc: Localized, minibatch_size: int,
     key_mask = np.zeros(kpad, np.float32)
     key_mask[:k] = 1.0
 
-    return SparseBatch(cols=cols, vals=vals, labels=labels, row_mask=row_mask,
-                       uniq_keys=uniq, key_mask=key_mask)
+    out = SparseBatch(cols=cols, vals=vals, labels=labels, row_mask=row_mask,
+                      uniq_keys=uniq, key_mask=key_mask)
+    # plain attribute (not a pytree leaf, dropped by device_put): lets eval
+    # consumers distinguish padded rows from real rows whose example weight
+    # is 0 — row_mask alone can't
+    out.num_real = blk.size
+    return out
 
 
 def nnz_bucket(densest: int, cap: int = 4096) -> int:

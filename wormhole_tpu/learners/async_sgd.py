@@ -61,7 +61,12 @@ class AsyncSGD:
         self.rt = runtime or MeshRuntime.create(cfg.mesh_shape)
         if store is None:
             lam = list(cfg.lambda_) + [0.0, 0.0]
-            penalty = L1L2(lambda1=lam[0], lambda2=lam[1])
+            # config.proto:34-39 — L1: λ0·‖w‖₁ + ½λ1·‖w‖²; L2: ½λ0·‖w‖²
+            from wormhole_tpu.utils.config import Penalty
+            if cfg.penalty == Penalty.L2:
+                penalty = L1L2(lambda1=0.0, lambda2=lam[0])
+            else:
+                penalty = L1L2(lambda1=lam[0], lambda2=lam[1])
             handle = create_handle(cfg.algo.value, penalty,
                                    LearnRate(cfg.lr_eta, cfg.lr_beta))
             store = ShardedStore(
@@ -79,6 +84,9 @@ class AsyncSGD:
                 f"store has num_buckets={buckets} but config says "
                 f"{cfg.num_buckets}")
         self.store = store
+        if cfg.test_data and not cfg.pred_out:
+            # fail at construction, not after hours of training
+            raise ValueError("test_data set but pred_out empty")
         self.localizer = Localizer(num_buckets=cfg.num_buckets,
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()
@@ -130,23 +138,35 @@ class AsyncSGD:
             yield batch
 
     def process(self, file: str, part: int, nparts: int,
-                kind: str = TRAIN) -> Progress:
-        """One workload part (AsyncSGDWorker::Process, async_sgd.h:57-127)."""
+                kind: str = TRAIN, pooled: Optional[list] = None) -> Progress:
+        """One workload part (AsyncSGDWorker::Process, async_sgd.h:57-127).
+
+        ``pooled``, if given on an eval/predict pass, collects
+        ``(margin, label, weight)`` triples of every real row so the caller
+        can compute pass-level metrics over the full eval output (the
+        reference evaluates AUC over the complete pass, evaluation.h:38-68,
+        not a mean of per-minibatch AUCs)."""
         cfg = self.cfg
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         inflight: deque = deque()
         local = Progress()
 
-        def harvest(metrics) -> None:
-            vals = [float(np.asarray(m)) for m in metrics]
-            objv, num_ex, a, acc = vals[:4]
+        def harvest(item) -> None:
+            metrics, labels, row_mask = item
+            metrics = jax.block_until_ready(metrics)
+            objv, num_ex, a, acc = (float(np.asarray(m))
+                                    for m in metrics[:4])
             local.objv += objv
             local.num_ex += int(num_ex)
             local.count += 1
             local.auc += a
             local.acc += acc
-            if len(vals) > 4:
-                local.wdelta2 += vals[4]
+            if kind == TRAIN and len(metrics) > 4:
+                local.wdelta2 += float(np.asarray(metrics[4]))
+            if pooled is not None and len(metrics) > 4:
+                margin = np.asarray(metrics[4])
+                keep = row_mask >= 0  # real rows (weight-0 rows included)
+                pooled.append((margin[keep], labels[keep], row_mask[keep]))
             if kind == TRAIN:  # eval metrics must not pollute train rows
                 self._display(local)
 
@@ -154,20 +174,38 @@ class AsyncSGD:
         # profile (the thing SURVEY §5.1 wants) stays unskewed
         pfx = "" if kind == TRAIN else "eval_"
         for batch in self._batches(file, part, nparts, pfx):
-            with self.timer.scope(pfx + "wait"):   # WaitMinibatch(max_delay)
-                while len(inflight) > max_delay:
-                    harvest(jax.block_until_ready(inflight.popleft()))
+            # WaitMinibatch gate BEFORE dispatch (the reference parses the
+            # next minibatch while steps are in flight, then waits,
+            # async_sgd.h:81,119-142): after dispatch at most
+            # max(max_delay, 1) device steps exist — max_delay=0 means no
+            # two device steps ever overlap (host parse still pipelines,
+            # matching the reference's WaitMinibatch placement).
+            with self.timer.scope(pfx + "wait"):
+                while len(inflight) > max(max_delay - 1, 0):
+                    harvest(inflight.popleft())
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
                     m = self.store.train_step(batch,
                                               tau=float(len(inflight)))
+                    inflight.append((m, None, None))
                 else:
-                    m = self.store.eval_step(batch)[:4]
-            inflight.append(m)
+                    m = self.store.eval_step(batch)
+                    keep = self._real_rows(batch)
+                    inflight.append((m, np.asarray(batch.labels), keep))
         with self.timer.scope(pfx + "wait"):       # WaitMinibatch(0)
             while inflight:
-                harvest(jax.block_until_ready(inflight.popleft()))
+                harvest(inflight.popleft())
         return local
+
+    @staticmethod
+    def _real_rows(batch) -> np.ndarray:
+        """Per-row (real, weight) for pooled eval: real rows are the first
+        ``num_real`` (set by pad_to_batch) — row_mask alone can't tell a
+        padded row from a real row with example weight 0."""
+        mask = np.asarray(batch.row_mask)
+        n = getattr(batch, "num_real", None)
+        real = (np.arange(len(mask)) < n) if n is not None else mask > 0
+        return np.where(real, np.maximum(mask, 0.0), -1.0)
 
     # -- scheduler loop -----------------------------------------------------
 
@@ -199,6 +237,11 @@ class AsyncSGD:
             if start_pass:
                 self.store.restore_pytree(state)
                 log.info("resumed at data pass %d", start_pass)
+        if not start_pass and cfg.model_in:
+            # warm start (reference model_in + Broadcast, linear.cc:115-123);
+            # a checkpoint resume supersedes it
+            self.store.load_model(cfg.model_in)
+            log.info("warm start from %s", cfg.model_in)
         for data_pass in range(start_pass, cfg.max_data_pass):
             self.pool.clear()
             self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
@@ -214,11 +257,13 @@ class AsyncSGD:
             if cfg.checkpoint_dir and self._ckpt_ok():
                 self.ckpt.save(data_pass + 1, self.store.state_pytree())
             if cfg.val_data:
-                vp = self._run_eval(cfg.val_data)
+                vp, pass_auc = self._run_eval(cfg.val_data)
                 n = max(vp.num_ex, 1)
                 log.info("pass %d validation: objv=%.6f auc=%.6f acc=%.6f",
-                         data_pass, vp.objv / n, vp.auc / max(vp.count, 1),
+                         data_pass, vp.objv / n, pass_auc,
                          vp.acc / max(vp.count, 1))
+        if cfg.test_data:
+            self.predict(cfg.test_data, cfg.pred_out)
         if cfg.model_out:
             self.store.save_model(cfg.model_out, self.rt.rank)
         if self.timer.totals:
@@ -275,6 +320,14 @@ class AsyncSGD:
         if not (cfg.max_nnz and cfg.key_pad):
             raise ValueError("multi-host sync training needs static "
                              "max_nnz= and key_pad= config")
+        if cfg.test_data:
+            raise NotImplementedError(
+                "TEST/predict workloads are single-host for now; run "
+                "task=predict separately on the saved model")
+        if cfg.model_in:
+            # every host reads the same file → identical warm-start table
+            self.store.load_model(cfg.model_in)
+            log.info("warm start from %s", cfg.model_in)
         self._max_nnz = cfg.max_nnz
         files = [fi.path for fi in list_files(cfg.train_data)]
         if not files:
@@ -306,10 +359,11 @@ class AsyncSGD:
                     break
                 batch = self._global_batch(
                     blk if blk is not None else self._empty_local_batch())
-                while len(inflight) > cfg.max_delay:
-                    harvest(jax.block_until_ready(inflight.popleft()))
                 inflight.append(
                     self.store.train_step(batch, tau=float(len(inflight))))
+                # cap in-flight steps at max_delay (0 → synchronous)
+                while len(inflight) > cfg.max_delay:
+                    harvest(jax.block_until_ready(inflight.popleft()))
             while inflight:
                 harvest(jax.block_until_ready(inflight.popleft()))
         self.progress.merge(local)
@@ -338,17 +392,59 @@ class AsyncSGD:
                 "export (model_out) instead")
         return ok
 
-    def _run_eval(self, pattern: str) -> Progress:
+    def _run_eval(self, pattern: str):
+        """Full eval pass; returns (Progress, pooled AUC over the whole
+        pass). The per-minibatch mean AUC stays in Progress for display; the
+        pooled number is the unbiased pass-level statistic."""
+        from wormhole_tpu.ops.metrics import auc_np
         pool = WorkloadPool()
         pool.add(pattern, self.cfg.num_parts_per_file, VAL)
         total = Progress()
+        pooled: list = []
         while True:
             wl = pool.get("eval")
             if wl is None:
                 break
-            total.merge(self.process(wl.file, wl.part, wl.nparts, VAL))
+            total.merge(self.process(wl.file, wl.part, wl.nparts, VAL,
+                                     pooled=pooled))
             pool.finish(wl.id)
-        return total
+        if pooled:
+            margins = np.concatenate([p[0] for p in pooled])
+            labels = np.concatenate([p[1] for p in pooled])
+            weights = np.concatenate([p[2] for p in pooled])
+            pass_auc = auc_np(labels, margins, weights)
+        else:
+            pass_auc = 0.5
+        return total, pass_auc
+
+    def predict(self, pattern: str, out_path: str) -> None:
+        """TEST workload (reference workload.proto:12-16 TEST type): stream
+        the test data, write one prediction per real row to ``pred_out`` —
+        σ(margin) for logit loss (linear.h MarginToPred), the raw margin
+        otherwise."""
+        from wormhole_tpu.data.stream import open_stream
+        from wormhole_tpu.sched.workload_pool import TEST
+        if not out_path:
+            raise ValueError("test_data set but pred_out empty")
+        pool = WorkloadPool()
+        pool.add(pattern, self.cfg.num_parts_per_file, TEST)
+        pooled: list = []
+        while True:
+            wl = pool.get("predict")
+            if wl is None:
+                break
+            self.process(wl.file, wl.part, wl.nparts, TEST, pooled=pooled)
+            pool.finish(wl.id)
+        margins = (np.concatenate([p[0] for p in pooled])
+                   if pooled else np.zeros(0, np.float32))
+        if self.cfg.loss.value == "logit":
+            preds = 1.0 / (1.0 + np.exp(-margins))
+        else:
+            preds = margins
+        with open_stream(out_path, "w") as f:
+            for p in preds:
+                f.write(f"{p:.6g}\n")
+        log.info("wrote %d predictions to %s", len(preds), out_path)
 
     # -- observability ------------------------------------------------------
 

@@ -60,6 +60,13 @@ class Handle:
              tau: jax.Array) -> jax.Array:
         raise NotImplementedError
 
+    def warm_start(self, w: jax.Array) -> jax.Array:
+        """Slots that make ``w`` a fixed point of a zero-gradient push
+        (model_in warm start, linear.cc:115-123). Default: w in slot 0,
+        accumulators zeroed — correct for the direct-update handles."""
+        slots = jnp.zeros(w.shape + (self.val_len,), jnp.float32)
+        return slots.at[..., 0].set(w)
+
 
 @dataclass(frozen=True)
 class SGDHandle(Handle):
@@ -105,6 +112,16 @@ class FTRLHandle(Handle):
         w_new = self.penalty.solve(
             -z_new, (self.lr.beta + cg_new) / self.lr.alpha)
         return jnp.stack([w_new, z_new, cg_new], axis=-1)
+
+    def warm_start(self, w):
+        """FTRL derives w from z (w = prox(−z)), so a warm start must seed
+        z with the value whose prox is w — slot 0 alone would be erased by
+        the first push. With cg=0: prox(−z) = shrink(−z, λ1)/(β/α + λ2),
+        so z = −(w·(β/α + λ2) + λ1·sign(w))."""
+        p = self.penalty
+        z = -(w * (self.lr.beta / self.lr.alpha + p.lambda2)
+              + p.lambda1 * jnp.sign(w))
+        return jnp.stack([w, z, jnp.zeros_like(w)], axis=-1)
 
 
 @dataclass(frozen=True)

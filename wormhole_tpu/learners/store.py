@@ -190,7 +190,7 @@ class ShardedStore(TableCheckpoint):
             if ln.strip():
                 k, v = ln.split()
                 w[int(k)] = float(v)
-        slots = np.array(self.slots)  # copy: device buffers are read-only
-        slots[:, 0] = w
-        self.slots = jax.device_put(jnp.asarray(slots),
+        # handle-aware warm start: slots such that w is a fixed point of a
+        # zero-gradient push (FTRL must seed z, not just slot 0)
+        self.slots = jax.device_put(self.handle.warm_start(jnp.asarray(w)),
                                     self.slots.sharding)

@@ -33,6 +33,21 @@ def logit_dual(margin: jax.Array, labels: jax.Array,
     return -y * jax.nn.sigmoid(-y * margin) * mask
 
 
+def hinge_objv(margin: jax.Array, labels: jax.Array,
+               mask: jax.Array) -> jax.Array:
+    """Σ max(0, 1 - y·m) over real rows (config.proto Loss HINGE)."""
+    t = jnp.maximum(0.0, 1.0 - _to_pm1(labels) * margin)
+    return jnp.sum(t * mask)
+
+
+def hinge_dual(margin: jax.Array, labels: jax.Array,
+               mask: jax.Array) -> jax.Array:
+    """Subgradient: -y where the margin is violated, else 0."""
+    y = _to_pm1(labels)
+    active = (1.0 - y * margin > 0).astype(margin.dtype)
+    return -y * active * mask
+
+
 def square_hinge_objv(margin: jax.Array, labels: jax.Array,
                       mask: jax.Array) -> jax.Array:
     """Σ max(0, 1 - y·m)² over real rows."""
@@ -60,6 +75,7 @@ def square_dual(margin: jax.Array, labels: jax.Array,
 
 _LOSSES = {
     "logit": (logit_objv, logit_dual),
+    "hinge": (hinge_objv, hinge_dual),
     "square_hinge": (square_hinge_objv, square_hinge_dual),
     "square": (square_objv, square_dual),
 }

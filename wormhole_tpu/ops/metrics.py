@@ -12,29 +12,46 @@ import jax.numpy as jnp
 
 
 def auc(labels: jax.Array, margin: jax.Array, mask: jax.Array) -> jax.Array:
-    """Area under the ROC curve via the rank-sum formulation.
+    """Area under the ROC curve via the weighted Mann-Whitney statistic.
 
-    Masked rows get a margin of -inf and weight 0 so they never contribute.
-    Returns 0.5 when either class is empty (matching the reference's
-    degenerate behavior of an undefined AUC)."""
-    pos = (labels > 0.5).astype(jnp.float32) * mask
-    neg = mask - pos
-    # ranks of each row by margin, average-free (ties broken by sort order,
-    # same as the reference's sort-based computation)
+    ``mask`` doubles as per-row weight (the feed writes example weights into
+    row_mask), so fractional weights are exact: each positive counts the
+    total negative weight ranked strictly below it, normalized by W⁺·W⁻.
+    Ties are broken by sort order (same as the reference's sort-based
+    computation, evaluation.h:38-68). Masked rows carry weight 0 and never
+    contribute. Returns 0.5 when either class is empty (matching the
+    reference's degenerate behavior of an undefined AUC)."""
+    pos_w = (labels > 0.5).astype(jnp.float32) * mask
+    neg_w = mask - pos_w
     order = jnp.argsort(jnp.where(mask > 0, margin, -jnp.inf))
-    ranks = jnp.zeros_like(margin).at[order].set(
-        jnp.arange(1, margin.shape[0] + 1, dtype=jnp.float32))
-    npos = jnp.sum(pos)
-    nneg = jnp.sum(neg)
-    rank_sum = jnp.sum(ranks * pos)
-    # subtract ranks occupied by masked rows (they sort to the bottom, so
-    # real rows' ranks are already offset correctly only when masked rows
-    # rank lowest — which -inf guarantees... except they then occupy the
-    # lowest ranks; compensate by the count of masked rows below everything)
-    num_masked = margin.shape[0] - jnp.sum(mask)
-    rank_sum = rank_sum - num_masked * npos
-    a = (rank_sum - npos * (npos + 1) / 2) / jnp.maximum(npos * nneg, 1.0)
-    return jnp.where((npos > 0) & (nneg > 0), a, 0.5)
+    spos = pos_w[order]
+    sneg = neg_w[order]
+    # negative weight strictly below each sorted position
+    cumneg = jnp.cumsum(sneg) - sneg
+    wpos = jnp.sum(pos_w)
+    wneg = jnp.sum(neg_w)
+    a = jnp.sum(spos * cumneg) / jnp.maximum(wpos * wneg, 1e-30)
+    return jnp.where((wpos > 0) & (wneg > 0), a, 0.5)
+
+
+def auc_np(labels, margin, weights=None) -> float:
+    """Host (numpy) pooled AUC over a full eval pass — the reference
+    evaluates AUC on the complete eval output (evaluation.h:38-68), not a
+    mean of per-minibatch AUCs."""
+    import numpy as np
+    labels = np.asarray(labels, np.float64)
+    margin = np.asarray(margin, np.float64)
+    w = np.ones_like(labels) if weights is None else np.asarray(
+        weights, np.float64)
+    pos_w = (labels > 0.5) * w
+    neg_w = w - pos_w
+    order = np.argsort(margin, kind="stable")
+    spos, sneg = pos_w[order], neg_w[order]
+    cumneg = np.cumsum(sneg) - sneg
+    wp, wn = pos_w.sum(), neg_w.sum()
+    if wp <= 0 or wn <= 0:
+        return 0.5
+    return float(np.sum(spos * cumneg) / (wp * wn))
 
 
 def accuracy(labels: jax.Array, margin: jax.Array, mask: jax.Array,

@@ -26,7 +26,7 @@ from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("workload_pool")
 
-TRAIN, VAL = "train", "val"
+TRAIN, VAL, TEST = "train", "val", "test"  # workload.proto:12-16 types
 
 
 @dataclass

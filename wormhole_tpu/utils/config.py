@@ -59,6 +59,7 @@ class Config:
     # --- model ---
     model_in: str = ""
     model_out: str = ""
+    pred_out: str = ""  # predictions for test_data (TEST workload output)
 
     loss: Loss = Loss.LOGIT
     penalty: Penalty = Penalty.L1

@@ -1,22 +1,31 @@
-"""Headline benchmark: FTRL async-SGD training throughput (examples/sec).
+"""Headline benchmark: END-TO-END streaming FTRL throughput (examples/sec).
 
 Mirrors the reference's flagship number — sparse logistic regression via
-FTRL on criteo-like data, 9.5M examples/sec on 5 EC2 c4.8x machines with
-100 workers + 100 servers (learn/linear/guide/criteo.md:208-210; conf:
-minibatch=100K, max_delay=4). Here: the fused pull→forward→backward→push
-device step of the sharded learner (wormhole_tpu/learners/store.py) on
-criteo-shaped synthetic batches (39 features/row, hashed key space), with
-the reference's minibatch=100K and a max_delay=4 dispatch window, on
-whatever chips are visible.
+FTRL on criteo-shaped data at 9.5M examples/sec on 5 EC2 c4.8x machines
+(100 workers + 100 servers, minibatch=100K, max_delay=4;
+learn/linear/guide/criteo.md:205-210). That number includes the data
+pipeline, so the headline here does too: real bytes stream from disk
+through the framework's feed (crec columnar blocks → device_put →
+on-device key fold → fused dense-apply FTRL step) with the max_delay
+dispatch window — the exact path `AsyncSGD.process` runs in production.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is examples/sec relative to the reference's 9,500,000 (its
-whole-cluster number — 180 c4.8x cores — vs this host's chips).
+The crec format is this framework's text2rec output (the reference also
+pre-converts hot data to binary recordio; text parsing at 9.5M rows/s took
+its 180-core cluster — a single host core cannot and is benched honestly
+as `criteo_text_examples_per_sec`).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+extra carries the device-step-only numbers (the round-1 metric), the text
+-path number, the achieved HBM bandwidth + roofline fraction, and the
+pipeline profile proving the e2e run is transfer/dispatch-bound, not
+parse-bound.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 import time
 from collections import deque
 
@@ -25,83 +34,255 @@ import numpy as np
 BASELINE_EX_PER_SEC = 9.5e6  # criteo.md:208-210
 
 MINIBATCH = 100_000          # criteo_s3.conf minibatch=100000
-NNZ = 64                     # criteo: 39 feats/row, padded bucket 64
+NNZ_PAD = 64                 # sparse path: 39 feats/row, padded bucket 64
+CRITEO_NNZ = 39
 KPAD = 1 << 20               # unique hashed keys per 100K-row batch
 NUM_BUCKETS = 1 << 22        # hashed model buckets (FLAGS_max_key analogue)
 MAX_DELAY = 4                # criteo_s3.conf max_delay=4
-WARMUP_STEPS = 5
-BENCH_STEPS = 60
-REPEATS = 3     # report the median window (tunnel/queue noise)
+E2E_ROWS = 4_000_000         # crec file size (628 MB; cache-resident)
+E2E_SECONDS = 12.0           # timed window
+TEXT_ROWS = 120_000          # criteo text sample for the text-path number
+
+# public peak HBM bandwidth by device kind (GB/s)
+HBM_PEAK = {"TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0,
+            "TPU v5": 2765.0, "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
+            "TPU v6e": 1640.0}
 
 
-def make_batch(rng, num_buckets: int):
+def make_sparse_batch(rng, num_buckets: int):
     from wormhole_tpu.data.feed import SparseBatch
     k = int(KPAD * 0.9)
     uniq = np.zeros(KPAD, np.int32)
     uniq[:k] = np.sort(rng.choice(num_buckets, size=k, replace=False))
     key_mask = np.zeros(KPAD, np.float32)
     key_mask[:k] = 1.0
-    cols = rng.integers(0, k, size=(MINIBATCH, NNZ)).astype(np.int32)
-    vals = np.zeros((MINIBATCH, NNZ), np.float32)
-    vals[:, :39] = 1.0  # criteo rows: 39 present features, binary/int values
+    cols = rng.integers(0, k, size=(MINIBATCH, NNZ_PAD)).astype(np.int32)
+    vals = np.zeros((MINIBATCH, NNZ_PAD), np.float32)
+    vals[:, :CRITEO_NNZ] = 1.0  # criteo rows: 39 binary/int features
     labels = (rng.random(MINIBATCH) < 0.25).astype(np.float32)
     row_mask = np.ones(MINIBATCH, np.float32)
     return SparseBatch(cols=cols, vals=vals, labels=labels,
                        row_mask=row_mask, uniq_keys=uniq, key_mask=key_mask)
 
 
-def main() -> None:
+def write_crec(path: str, rows: int, rng) -> None:
+    from wormhole_tpu.data.crec import CRecWriter
+    with CRecWriter(path, nnz=CRITEO_NNZ, block_rows=MINIBATCH) as w:
+        chunk = 500_000
+        done = 0
+        while done < rows:
+            n = min(chunk, rows - done)
+            keys = rng.integers(0, 1 << 32, size=(n, CRITEO_NNZ),
+                                dtype=np.uint32)
+            keys[keys == 0xFFFFFFFF] = 0
+            labels = (rng.random(n) < 0.25).astype(np.uint8)
+            w.append(keys, labels)
+            done += n
+
+
+def write_criteo_text(path: str, rows: int, rng) -> None:
+    """Vectorized synthetic criteo text (label \\t 13 ints \\t 26 cats)."""
+    ints = rng.integers(0, 65536, size=(rows, 13)).astype("U6")
+    cats = rng.integers(0, 1 << 32, size=(rows, 26))
+    labels = (rng.random(rows) < 0.25).astype(np.int64).astype("U1")
+    with open(path, "w") as f:
+        for i in range(rows):
+            f.write(labels[i] + "\t" + "\t".join(ints[i]) + "\t"
+                    + "\t".join(f"{c:08x}" for c in cats[i]) + "\n")
+
+
+def make_app(cfg_kwargs):
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    import jax
+    rt = MeshRuntime.create()
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        model = 2 if n_dev % 2 == 0 else 1
+        rt.mesh = make_mesh(f"data:{n_dev // model},model:{model}")
+    cfg = Config(**cfg_kwargs)
+    cfg.lambda_ = [1.0, 0.1]
+    return AsyncSGD(cfg, rt)
+
+
+def bench_e2e_crec(path: str) -> dict:
+    """The headline: stream crec bytes from disk through AsyncSGD.process
+    (prefetch thread → device_put → fused dense-apply step, max_delay
+    window)."""
+    app = make_app(dict(train_data=path, data_format="crec", minibatch=MINIBATCH,
+                        max_delay=MAX_DELAY, num_buckets=NUM_BUCKETS,
+                        lr_eta=0.1, disp_itv=1e12))
+    app.process(path, 0, 1)  # warmup pass: compile + cache
+    app.timer.totals.clear()
+    app.timer.counts.clear()
+    t0 = time.perf_counter()
+    rows = 0
+    passes = 0
+    while True:
+        prog = app.process(path, 0, 1)
+        rows += prog.num_ex
+        passes += 1
+        if time.perf_counter() - t0 >= E2E_SECONDS:
+            break
+    elapsed = time.perf_counter() - t0
+    prof = {k: round(app.timer.totals.get(k, 0.0), 3)
+            for k in ("put", "dispatch", "wait")}
+    return {"ex_per_sec": rows / elapsed, "passes": passes,
+            "pipeline_profile_sec": prof,
+            "bytes_per_row": CRITEO_NNZ * 4 + 1}
+
+
+def bench_e2e_text(path: str) -> dict:
+    """Reference-format (criteo text) end-to-end on this host's cores —
+    parse-bound; the reference spent 180 cores on this."""
+    app = make_app(dict(train_data=path, data_format="criteo",
+                        minibatch=20_000, max_delay=MAX_DELAY,
+                        num_buckets=NUM_BUCKETS, lr_eta=0.1, disp_itv=1e12))
+    app.process(path, 0, 1)  # warmup/compile
+    t0 = time.perf_counter()
+    prog = app.process(path, 0, 1)
+    elapsed = time.perf_counter() - t0
+    return {"ex_per_sec": prog.num_ex / elapsed}
+
+
+def _median_window(fn, repeats=3):
+    times = []
+    for _ in range(repeats):
+        times.append(fn())
+    return sorted(times)[len(times) // 2]
+
+
+def bench_device_sparse() -> float:
+    """Round-1 metric: the fused sparse step on device-resident batches."""
     import jax
     from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
     from wormhole_tpu.learners.store import ShardedStore, StoreConfig
     from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.data.loader import dense_batch_sharding
     from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
-
     rng = np.random.default_rng(0)
-    n_dev = len(jax.devices())
     rt = MeshRuntime.create()
+    n_dev = len(jax.devices())
     if n_dev > 1:
         model = 2 if n_dev % 2 == 0 else 1
         rt.mesh = make_mesh(f"data:{n_dev // model},model:{model}")
-
     handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
-    store = ShardedStore(
-        StoreConfig(num_buckets=NUM_BUCKETS, loss="logit"), handle, rt)
-
-    from wormhole_tpu.data.loader import dense_batch_sharding
+    store = ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS, loss="logit"),
+                         handle, rt)
     sharding = dense_batch_sharding(rt)
-    batches = []
-    for i in range(4):  # a few distinct batches so keys vary
-        b = make_batch(rng, NUM_BUCKETS)
-        # always resident on device: the bench measures the train step, not
-        # host->device transfer (streaming feed is benched separately)
-        batches.append(jax.device_put(b, sharding))
-
+    batches = [jax.device_put(make_sparse_batch(rng, NUM_BUCKETS), sharding)
+               for _ in range(4)]
     inflight: deque = deque()
-    for i in range(WARMUP_STEPS):
-        inflight.append(store.train_step(batches[i % len(batches)]))
-    while inflight:
-        jax.block_until_ready(inflight.popleft())
 
-    windows = []
-    for _ in range(REPEATS):
-        start = time.perf_counter()
-        for i in range(BENCH_STEPS):
+    def window(steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
             while len(inflight) > MAX_DELAY:
                 jax.block_until_ready(inflight.popleft())
-            inflight.append(store.train_step(batches[i % len(batches)]))
+            inflight.append(store.train_step(batches[i % 4]))
         while inflight:
             jax.block_until_ready(inflight.popleft())
-        jax.block_until_ready(store.slots)  # the full update chain is done
-        windows.append(time.perf_counter() - start)
-    elapsed = sorted(windows)[len(windows) // 2]
+        jax.block_until_ready(store.slots)
+        float(np.asarray(store.slots[0, 0]))  # force real completion (D2H)
+        return time.perf_counter() - t0
 
-    ex_per_sec = BENCH_STEPS * MINIBATCH / elapsed
+    window(5)  # warmup
+    elapsed = _median_window(lambda: window(60))
+    return 60 * MINIBATCH / elapsed
+
+
+def bench_device_dense() -> dict:
+    """Dense-apply step on resident packed blocks; overhead-cancelled
+    timing (t(2N)−t(N))/N, with a forced D2H read so tunnel futures can't
+    fake completion."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    rng = np.random.default_rng(1)
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    store = ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS, loss="logit"),
+                         handle)
+    bufs = []
+    for _ in range(4):
+        keys = rng.integers(0, 1 << 32, size=MINIBATCH * CRITEO_NNZ,
+                            dtype=np.uint32)
+        labels = (rng.random(MINIBATCH) < 0.25).astype(np.uint8)
+        bufs.append(jax.device_put(
+            np.concatenate([keys.view(np.uint8), labels])))
+
+    def run(steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            store.dense_train_step(bufs[i % 4], MINIBATCH, CRITEO_NNZ,
+                                   donate_packed=False)
+        jax.block_until_ready(store.slots)
+        float(np.asarray(store.slots[0, 0]))
+        return time.perf_counter() - t0
+
+    run(5)  # warmup
+    n = 30
+    t1 = _median_window(lambda: run(n))
+    t2 = _median_window(lambda: run(2 * n))
+    per_step = max((t2 - t1) / n, 1e-9)
+    # bytes moved per step: slots r/w, grad table zeros+read+write,
+    # gather/scatter of R*N entries, packed block read
+    step_bytes = (2 * NUM_BUCKETS * 3 * 4 + 3 * NUM_BUCKETS * 4
+                  + 3 * MINIBATCH * CRITEO_NNZ * 4
+                  + MINIBATCH * (CRITEO_NNZ * 4 + 1))
+    return {"ex_per_sec": MINIBATCH / per_step,
+            "step_ms": per_step * 1e3,
+            "hbm_gbps": step_bytes / per_step / 1e9,
+            "step_bytes": step_bytes}
+
+
+def main() -> None:
+    import jax
+    kind = jax.devices()[0].device_kind
+    peak = HBM_PEAK.get(kind)
+
+    workdir = tempfile.mkdtemp(prefix="wh_bench_")
+    rng = np.random.default_rng(0)
+    crec_path = os.path.join(workdir, "bench.crec")
+    text_path = os.path.join(workdir, "bench.criteo")
+    write_crec(crec_path, E2E_ROWS, rng)
+    write_criteo_text(text_path, TEXT_ROWS, rng)
+
+    e2e = bench_e2e_crec(crec_path)
+    text = bench_e2e_text(text_path)
+    sparse = bench_device_sparse()
+    dense = bench_device_dense()
+
+    for p in (crec_path, text_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+    value = e2e["ex_per_sec"]
+    frac = (dense["hbm_gbps"] / peak) if peak else None
     print(json.dumps({
-        "metric": "ftrl_async_sgd_examples_per_sec",
-        "value": round(ex_per_sec, 1),
+        "metric": "end_to_end_examples_per_sec",
+        "value": round(value, 1),
         "unit": "examples/sec",
-        "vs_baseline": round(ex_per_sec / BASELINE_EX_PER_SEC, 4),
+        "vs_baseline": round(value / BASELINE_EX_PER_SEC, 4),
+        "extra": {
+            "device_kind": kind,
+            "host_cores": os.cpu_count(),
+            "e2e": {k: (round(v, 1) if isinstance(v, float) else v)
+                    for k, v in e2e.items()},
+            "criteo_text_examples_per_sec": round(text["ex_per_sec"], 1),
+            "device_step_sparse_examples_per_sec": round(sparse, 1),
+            "device_step_dense_examples_per_sec":
+                round(dense["ex_per_sec"], 1),
+            "dense_step_ms": round(dense["step_ms"], 3),
+            "dense_step_bytes": dense["step_bytes"],
+            "hbm_gbps": round(dense["hbm_gbps"], 1),
+            "hbm_peak_gbps": peak,
+            "roofline_frac": round(frac, 3) if frac is not None else None,
+        },
     }))
 
 

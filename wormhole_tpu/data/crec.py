@@ -1,0 +1,260 @@
+"""crec: columnar fixed-nnz record blocks — the TPU device-feed format.
+
+The reference converts hot text formats to binary RecordIO precisely because
+text parsing can't feed the cluster (``learn/linear/tool/text2rec.cc``); crec
+is that idea taken to its TPU-native conclusion (SURVEY.md §7 hard part (d)):
+a block's on-disk bytes ARE the device feed. A block holds ``block_rows``
+rows as one contiguous buffer
+
+    keys   u32[block_rows * nnz]   (row-major)
+    labels u8 [block_rows]
+
+and the streaming path ships that buffer to the device with a single
+``device_put`` — no per-row parse, no host-side localization (key folding
+happens on device, see learners/store.py dense-apply). 16 MB-ish blocks are
+the measured sweet spot of the host→device interconnect.
+
+File layout (little-endian):
+
+    header (32 B): magic "WCREC\\x01\\0\\0", nnz u32, block_rows u32,
+                   total_rows u64, reserved u64
+    ceil(total_rows / block_rows) blocks; every block holds exactly
+    ``block_rows`` rows except the last, which holds the remainder.
+
+Missing feature slots (criteo rows with empty fields) carry the sentinel key
+0xFFFFFFFF — the device step masks them out of the margin and the gradient.
+Padded rows (readers pad the tail block to a static shape) carry label 255.
+
+Part semantics: part k of n owns a contiguous range of *blocks* — the crec
+analogue of InputSplit's byte-range ownership, exact because blocks are
+fixed-size and seekable.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import queue
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"WCREC\x01\x00\x00"
+_HDR = struct.Struct("<8sIIQQ")  # magic, nnz, block_rows, total_rows, rsvd
+HEADER_SIZE = _HDR.size
+SENTINEL_KEY = np.uint32(0xFFFFFFFF)
+PAD_LABEL = 255
+
+
+@dataclass(frozen=True)
+class CRecInfo:
+    nnz: int
+    block_rows: int
+    total_rows: int
+
+    @property
+    def block_bytes(self) -> int:
+        return self.block_rows * (self.nnz * 4 + 1)
+
+    @property
+    def num_blocks(self) -> int:
+        return -(-self.total_rows // self.block_rows) if self.total_rows else 0
+
+    def rows_in_block(self, i: int) -> int:
+        if i < self.num_blocks - 1:
+            return self.block_rows
+        tail = self.total_rows - (self.num_blocks - 1) * self.block_rows
+        return int(tail)
+
+    def block_offset(self, i: int) -> int:
+        return HEADER_SIZE + i * self.block_bytes
+
+    def block_nbytes(self, i: int) -> int:
+        r = self.rows_in_block(i)
+        return r * (self.nnz * 4 + 1)
+
+
+def read_header(path: str) -> CRecInfo:
+    from wormhole_tpu.data.stream import open_stream
+    with open_stream(path, "rb") as f:
+        raw = f.read(HEADER_SIZE)
+    magic, nnz, block_rows, total_rows, _ = _HDR.unpack(raw)
+    if magic != MAGIC:
+        raise ValueError(f"{path}: not a crec file (magic {magic!r})")
+    return CRecInfo(nnz=nnz, block_rows=block_rows, total_rows=total_rows)
+
+
+class CRecWriter:
+    """Stream rows into fixed-size blocks; ``close()`` patches total_rows.
+
+    ``append(keys, labels)``: keys u32 (n, nnz) with SENTINEL_KEY padding for
+    rows with fewer features; labels 0/1 (u8)."""
+
+    def __init__(self, path: str, nnz: int, block_rows: int = 100_000):
+        if block_rows <= 0 or nnz <= 0:
+            raise ValueError("nnz and block_rows must be positive")
+        self.path = path
+        self.nnz = nnz
+        self.block_rows = block_rows
+        self.total_rows = 0
+        self._buf_keys = np.empty((block_rows, nnz), np.uint32)
+        self._buf_labels = np.empty(block_rows, np.uint8)
+        self._fill = 0
+        self._f = open(path, "wb")
+        self._f.write(_HDR.pack(MAGIC, nnz, block_rows, 0, 0))
+
+    def append(self, keys: np.ndarray, labels: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint32)
+        labels = np.ascontiguousarray(labels, np.uint8)
+        if keys.ndim != 2 or keys.shape[1] != self.nnz:
+            raise ValueError(f"keys must be (n, {self.nnz}), got {keys.shape}")
+        n = keys.shape[0]
+        pos = 0
+        while pos < n:
+            take = min(n - pos, self.block_rows - self._fill)
+            self._buf_keys[self._fill:self._fill + take] = keys[pos:pos + take]
+            self._buf_labels[self._fill:self._fill + take] = \
+                labels[pos:pos + take]
+            self._fill += take
+            pos += take
+            if self._fill == self.block_rows:
+                self._flush_block(self.block_rows)
+
+    def _flush_block(self, rows: int) -> None:
+        self._f.write(self._buf_keys[:rows].tobytes())
+        self._f.write(self._buf_labels[:rows].tobytes())
+        self.total_rows += rows
+        self._fill = 0
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        if self._fill:
+            self._flush_block(self._fill)
+        self._f.seek(0)
+        self._f.write(_HDR.pack(MAGIC, self.nnz, self.block_rows,
+                                self.total_rows, 0))
+        self._f.close()
+        self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _part_block_range(info: CRecInfo, part: int, nparts: int) -> range:
+    nb = info.num_blocks
+    lo = part * nb // nparts
+    hi = (part + 1) * nb // nparts
+    return range(lo, hi)
+
+
+def iter_packed(path: str, part: int = 0, nparts: int = 1,
+                pad_tail: bool = True) -> Iterator[Tuple[np.ndarray, int]]:
+    """Yield ``(packed_u8, rows)`` per owned block.
+
+    ``packed_u8`` always has the full-block byte length (static shape for
+    jit); a short tail block is padded with sentinel keys and PAD_LABEL
+    when ``pad_tail`` (rows still reports the real count)."""
+    info = read_header(path)
+    blocks = _part_block_range(info, part, nparts)
+    if not len(blocks):
+        return
+    full = info.block_bytes
+    with open(path, "rb") as f:
+        for i in blocks:
+            rows = info.rows_in_block(i)
+            nbytes = info.block_nbytes(i)
+            f.seek(info.block_offset(i))
+            if rows == info.block_rows:
+                buf = np.empty(full, np.uint8)
+                got = f.readinto(memoryview(buf))
+                if got != full:
+                    raise IOError(f"{path}: truncated block {i}")
+                yield buf, rows
+            else:
+                raw = f.read(nbytes)
+                if len(raw) != nbytes:
+                    raise IOError(f"{path}: truncated tail block {i}")
+                if not pad_tail:
+                    yield np.frombuffer(raw, np.uint8).copy(), rows
+                    continue
+                buf = np.empty(full, np.uint8)
+                kb = rows * info.nnz * 4
+                kb_full = info.block_rows * info.nnz * 4
+                buf[:kb] = np.frombuffer(raw, np.uint8, kb)
+                buf[kb:kb_full] = 0xFF          # sentinel keys
+                buf[kb_full:kb_full + rows] = np.frombuffer(raw, np.uint8,
+                                                            rows, kb)
+                buf[kb_full + rows:] = PAD_LABEL
+                yield buf, rows
+
+
+def unpack_block(packed: np.ndarray,
+                 info: CRecInfo) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side view of a packed block: (keys (R, nnz) u32, labels u8)."""
+    kb = info.block_rows * info.nnz * 4
+    keys = packed[:kb].view(np.uint32).reshape(info.block_rows, info.nnz)
+    labels = packed[kb:kb + info.block_rows]
+    return keys, labels
+
+
+class PackedFeed:
+    """Prefetching device feed: a producer thread reads blocks and issues
+    ``device_put`` so transfer overlaps the consumer's dispatch loop (the
+    ThreadedParser of this path, minibatch_iter.h:50). Yields
+    ``(device_packed, host_packed, rows)``."""
+
+    def __init__(self, path: str, part: int = 0, nparts: int = 1,
+                 depth: int = 3, device_put=None):
+        self.path, self.part, self.nparts = path, part, nparts
+        self.depth = depth
+        self.read_time = 0.0
+        self.put_time = 0.0
+        self.bytes_read = 0
+        self._device_put = device_put
+
+    def __iter__(self):
+        import time as _time
+        import jax
+        put = self._device_put or jax.device_put
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        SENT = object()
+
+        def producer():
+            try:
+                for packed, rows in iter_packed(self.path, self.part,
+                                                self.nparts):
+                    t0 = _time.perf_counter()
+                    dev = put(packed)
+                    self.put_time += _time.perf_counter() - t0
+                    self.bytes_read += packed.nbytes
+                    while not stop.is_set():
+                        try:
+                            q.put((dev, packed, rows), timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                q.put(e)
+                return
+            q.put(SENT)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is SENT:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -48,6 +48,38 @@ def splitmix64_np(x: np.ndarray) -> np.ndarray:
         return x ^ (x >> _U64(31))
 
 
+_U32 = np.uint32
+
+
+def mix32_np(x: np.ndarray) -> np.ndarray:
+    """Finalizing 32-bit mixer (murmur3 fmix32 constants), vectorized numpy.
+
+    The host spec for the on-device key fold of the crec dense-apply path
+    (learners/store.py) — both must match bit-for-bit."""
+    x = x.astype(_U32, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> _U32(16)
+        x *= _U32(0x85EBCA6B)
+        x ^= x >> _U32(13)
+        x *= _U32(0xC2B2AE35)
+        return x ^ (x >> _U32(16))
+
+
+def fold_keys32(keys: np.ndarray, num_buckets: int) -> np.ndarray:
+    """Fold a 32-bit key space into [0, num_buckets) via mix32 — the crec
+    analogue of ``fold_keys`` (localizer.h:88-96 semantics, collisions
+    accepted)."""
+    return (mix32_np(keys) % _U32(num_buckets)).astype(np.int64)
+
+
+def key64_to_key32(keys: np.ndarray) -> np.ndarray:
+    """Map the 64-bit text-parser id space onto crec's u32 keys (splitmix64
+    then truncate). 0xFFFFFFFF is reserved as the missing-slot sentinel."""
+    k = splitmix64_np(np.asarray(keys, _U64)).astype(_U32)
+    # remap anything landing on the sentinel (1-in-4B keys)
+    return np.where(k == _U32(0xFFFFFFFF), _U32(0xFFFFFFFE), k)
+
+
 def fold_keys(keys: np.ndarray, num_buckets: int, hashed: bool = True) -> np.ndarray:
     """Fold a 64-bit key space into [0, num_buckets) bucket ids.
 

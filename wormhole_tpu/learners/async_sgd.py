@@ -146,6 +146,8 @@ class AsyncSGD:
         can compute pass-level metrics over the full eval output (the
         reference evaluates AUC over the complete pass, evaluation.h:38-68,
         not a mean of per-minibatch AUCs)."""
+        if self.cfg.data_format == "crec":
+            return self._process_crec(file, part, nparts, kind, pooled)
         cfg = self.cfg
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         inflight: deque = deque()
@@ -195,6 +197,69 @@ class AsyncSGD:
         with self.timer.scope(pfx + "wait"):       # WaitMinibatch(0)
             while inflight:
                 harvest(inflight.popleft())
+        return local
+
+    def _process_crec(self, file: str, part: int, nparts: int,
+                      kind: str, pooled: Optional[list]) -> Progress:
+        """The crec streaming fast path: packed block bytes go straight to
+        the device (PackedFeed prefetch thread overlaps transfer with
+        dispatch) and train via the store's fused dense-apply step — the
+        host does no per-row work at all (SURVEY §7 hard part (d))."""
+        from wormhole_tpu.data.crec import PackedFeed, read_header
+        cfg = self.cfg
+        if not hasattr(self.store, "dense_train_step"):
+            raise ValueError(
+                f"store {type(self.store).__name__} has no dense-apply "
+                "step; crec streaming needs the table-backed ShardedStore")
+        info = read_header(file)
+        kb = info.block_rows * info.nnz * 4
+        max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
+        inflight: deque = deque()
+        local = Progress()
+
+        def harvest(item) -> None:
+            metrics, labels_u8 = item
+            metrics = jax.block_until_ready(metrics)
+            objv, num_ex, a, acc = (float(np.asarray(m))
+                                    for m in metrics[:4])
+            local.objv += objv
+            local.num_ex += int(num_ex)
+            local.count += 1
+            local.auc += a
+            local.acc += acc
+            if kind == TRAIN and len(metrics) > 4:
+                local.wdelta2 += float(np.asarray(metrics[4]))
+            if pooled is not None and labels_u8 is not None:
+                margin = np.asarray(metrics[4])
+                real = labels_u8 != 255
+                pooled.append((margin[real],
+                               np.minimum(labels_u8[real], 1)
+                               .astype(np.float32),
+                               np.ones(int(real.sum()), np.float32)))
+            if kind == TRAIN:
+                self._display(local)
+
+        pfx = "" if kind == TRAIN else "eval_"
+        feed = PackedFeed(file, part, nparts)
+        for dev, host, rows in feed:
+            with self.timer.scope(pfx + "wait"):
+                while len(inflight) > max(max_delay - 1, 0):
+                    harvest(inflight.popleft())
+            with self.timer.scope(pfx + "dispatch"):
+                if kind == TRAIN:
+                    m = self.store.dense_train_step(
+                        dev, info.block_rows, info.nnz,
+                        tau=float(len(inflight)))
+                    inflight.append((m, None))
+                else:
+                    m = self.store.dense_eval_step(dev, info.block_rows,
+                                                   info.nnz)
+                    inflight.append(
+                        (m, host[kb:kb + info.block_rows].copy()))
+        with self.timer.scope(pfx + "wait"):
+            while inflight:
+                harvest(inflight.popleft())
+        self.timer.add(pfx + "put", feed.put_time)
         return local
 
     @staticmethod

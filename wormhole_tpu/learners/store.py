@@ -54,6 +54,28 @@ def shard_param_table(arr: jax.Array,
         arr, NamedSharding(runtime.mesh, P(MODEL_AXIS, None)))
 
 
+def mix32(h: jax.Array) -> jax.Array:
+    """Finalizing 32-bit mixer — must match ``hashing.mix32_np`` exactly
+    (the crec key fold runs on device; the host spec is numpy)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def supports_dense_apply(handle: Handle) -> bool:
+    """Dense apply pushes a zero gradient into every untouched bucket, so it
+    is exact only when a zero-grad push is the identity: always true for
+    FTRL (w is a pure function of z, which g=0 leaves unchanged), and true
+    for the direct-update handles only without a penalty (the prox would
+    re-shrink w every step)."""
+    from wormhole_tpu.learners.handles import FTRLHandle
+    if isinstance(handle, FTRLHandle):
+        return True
+    return handle.penalty.lambda1 == 0.0 and handle.penalty.lambda2 == 0.0
+
+
 def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
     """Symmetric fixed-point round-trip (FIXING_FLOAT filter semantics:
     lossy fixed-byte compression of values in transit)."""
@@ -143,6 +165,95 @@ class ShardedStore(TableCheckpoint):
             return objv, num_ex, a, acc, margin
 
         return ev
+
+    # -- dense-apply: the crec streaming fast path --------------------------
+    #
+    # One fused program over a packed crec block (data/crec.py): bitcast the
+    # raw bytes to u32 keys, fold to buckets ON DEVICE (mix32 — the host
+    # does zero key work), scatter-add the gradient into a table-sized
+    # buffer, and apply the handle to the WHOLE table. Exact vs the sparse
+    # path whenever zero-grad pushes are no-ops (supports_dense_apply);
+    # sentinel keys (missing criteo slots) and padded tail rows are masked.
+
+    def _dense_step(self, block_rows: int, nnz: int, kind: str,
+                    donate_packed: bool):
+        key = (block_rows, nnz, kind, donate_packed)
+        fn = getattr(self, "_dense_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        if kind == "train" and not supports_dense_apply(self.handle):
+            raise ValueError(
+                "dense apply needs FTRL or a penalty-free handle "
+                "(zero-grad pushes must be identity); use the sparse path")
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+        nb = self.cfg.num_buckets
+        R, N = block_rows, nnz
+        nk = R * N * 4
+
+        def fold_and_forward(slots, packed):
+            keys = jax.lax.bitcast_convert_type(
+                packed[:nk].reshape(-1, 4), jnp.uint32)
+            valid = (keys != jnp.uint32(0xFFFFFFFF))
+            b = (mix32(keys) % jnp.uint32(nb)).astype(jnp.int32)
+            b = jnp.where(valid, b, 0)
+            lab_u8 = packed[nk:nk + R]
+            row_mask = (lab_u8 != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
+            w = handle.weights(slots)
+            vf = valid.astype(jnp.float32).reshape(R, N)
+            margin = jnp.sum(w[b.reshape(R, N)] * vf, axis=1)
+            return b, vf, labels, row_mask, margin
+
+        if kind == "train":
+            donate = (0, 1) if donate_packed else (0,)
+
+            @partial(jax.jit, donate_argnums=donate)
+            def step(slots, packed, t, tau):
+                b, vf, labels, row_mask, margin = fold_and_forward(slots,
+                                                                  packed)
+                objv = objv_fn(margin, labels, row_mask)
+                dual = dual_fn(margin, labels, row_mask)
+                contrib = (dual[:, None] * vf).reshape(-1)
+                grad = jnp.zeros((nb,), jnp.float32).at[b].add(contrib)
+                new = handle.push(slots, grad, t, tau)
+                num_ex = jnp.sum(row_mask)
+                a = auc(labels, margin, row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                d0 = new[:, 0] - slots[:, 0]
+                return new, (objv, num_ex, a, acc, jnp.sum(d0 * d0))
+        else:
+            @jax.jit
+            def step(slots, packed):
+                _, _, labels, row_mask, margin = fold_and_forward(slots,
+                                                                  packed)
+                objv = objv_fn(margin, labels, row_mask)
+                num_ex = jnp.sum(row_mask)
+                a = auc(labels, margin, row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                return objv, num_ex, a, acc, margin
+
+        if not hasattr(self, "_dense_cache"):
+            self._dense_cache = {}
+        self._dense_cache[key] = step
+        return step
+
+    def dense_train_step(self, packed: jax.Array, block_rows: int,
+                         nnz: int, tau: float = 0.0,
+                         donate_packed: bool = True):
+        """Fused crec-block step. ``packed`` is the device-resident raw
+        block buffer; it is DONATED by default (dead after the call) — the
+        streaming feed never reuses a block, and donation avoids a
+        defensive input copy on some transports."""
+        step = self._dense_step(block_rows, nnz, "train", donate_packed)
+        self.slots, metrics = step(
+            self.slots, packed, jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def dense_eval_step(self, packed: jax.Array, block_rows: int, nnz: int):
+        return self._dense_step(block_rows, nnz, "eval", False)(
+            self.slots, packed)
 
     # -- the ZPush/ZPull surface --------------------------------------------
 

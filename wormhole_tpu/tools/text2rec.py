@@ -35,12 +35,18 @@ class Text2RecConfig:
     format: str = "criteo"
     part: int = 0
     nparts: int = 1
+    # --- crec output (out_format=crec) ---
+    out_format: str = "recordio"  # recordio | crec
+    nnz: int = 0                  # crec fixed row width; 0 = 39 for criteo
+    block_rows: int = 100_000     # crec block size (the device-put unit)
 
 
 def convert(cfg: Text2RecConfig) -> int:
     """Returns number of rows written."""
     if not cfg.input or not cfg.output:
         raise ValueError("need input=<uri> output=<uri>")
+    if cfg.out_format == "crec":
+        return convert_crec(cfg)
     src = InputSplit(cfg.input, cfg.part, cfg.nparts, split_type="text")
     rows = 0
     t0 = get_time()
@@ -53,6 +59,44 @@ def convert(cfg: Text2RecConfig) -> int:
                     float(blk.label[i]), blk.index[s:e],
                     None if blk.value is None else blk.value[s:e]))
             rows += blk.size
+    log.info("wrote %d rows (%.1f MB read) in %.2fs", rows,
+             src.bytes_read() / 1e6, get_time() - t0)
+    return rows
+
+
+def convert_crec(cfg: Text2RecConfig) -> int:
+    """Text → crec columnar blocks (the TPU device-feed format,
+    data/crec.py): 64-bit parser ids are mapped onto u32 (key64_to_key32),
+    rows are truncated/sentinel-padded to the fixed ``nnz`` width, labels
+    are binarized. Values are dropped — crec is for the binary-feature
+    streaming path (criteo/adfea); use recordio for valued data."""
+    import numpy as np
+    from wormhole_tpu.data.crec import CRecWriter, SENTINEL_KEY
+    from wormhole_tpu.data.hashing import key64_to_key32
+    nnz = cfg.nnz or (39 if cfg.format == "criteo" else 0)
+    if not nnz:
+        raise ValueError("crec output needs nnz=<fixed row width>")
+    src = InputSplit(cfg.input, cfg.part, cfg.nparts, split_type="text")
+    rows = 0
+    trunc = 0
+    t0 = get_time()
+    with CRecWriter(cfg.output, nnz=nnz, block_rows=cfg.block_rows) as w:
+        for blk in iter_blocks(src, cfg.format):
+            n = blk.size
+            k32 = key64_to_key32(blk.index)
+            per_row = np.diff(blk.offset)
+            keys = np.full((n, nnz), SENTINEL_KEY, np.uint32)
+            row_ids = np.repeat(np.arange(n, dtype=np.int64), per_row)
+            pos = np.arange(blk.nnz, dtype=np.int64) - np.repeat(
+                blk.offset[:-1].astype(np.int64), per_row)
+            keep = pos < nnz
+            trunc += int((~keep).sum())
+            keys[row_ids[keep], pos[keep]] = k32[keep]
+            w.append(keys, (blk.label > 0.5).astype(np.uint8))
+            rows += n
+    if trunc:
+        log.warning("%d entries truncated (rows wider than nnz=%d)",
+                    trunc, nnz)
     log.info("wrote %d rows (%.1f MB read) in %.2fs", rows,
              src.bytes_read() / 1e6, get_time() - t0)
     return rows

@@ -28,6 +28,11 @@ class Timer:
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
 
+    def add(self, name: str, seconds: float, calls: int = 1) -> None:
+        """Merge externally-measured time (e.g. from a feed thread)."""
+        self.totals[name] = self.totals.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + calls
+
     def report(self) -> str:
         rows = [
             f"{name}: {self.totals[name]:.3f}s / {self.counts[name]} calls"

@@ -1,0 +1,103 @@
+"""Tile-blocked MXU gather/scatter vs the exact numpy reference.
+
+Mirrors the reference's kernel-test style (spmv_test.cc:16-89 checks the
+parallel SpMV against the single-thread result); here the tiled matmul
+formulation is checked against a scatter/gather oracle, including padding,
+masked pairs, and the overflow spill path.
+"""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.ops import tilemm
+
+SPEC = tilemm.TileSpec(nb=2 * tilemm.TILE, subblocks=2, cap=1280,
+                       group=2, tiles_step=2)
+
+
+def make_pairs(rng, n_pairs, spec=SPEC, rows_limit=None):
+    buckets = rng.integers(0, spec.nb, size=n_pairs).astype(np.int64)
+    rows = rng.integers(0, rows_limit or spec.block_rows,
+                        size=n_pairs).astype(np.int64)
+    return buckets, rows
+
+
+def test_encode_roundtrip():
+    rng = np.random.default_rng(0)
+    buckets, rows = make_pairs(rng, 2000)
+    hl, rd, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    assert hl.shape == SPEC.pairs_shape
+    assert len(ovb) == 0
+    # decode every non-pad pair and compare multisets
+    hl_f = hl.reshape(SPEC.tiles, SPEC.subblocks, SPEC.cap)
+    rd_f = rd.reshape(SPEC.tiles, SPEC.subblocks, SPEC.cap)
+    got = []
+    for t in range(SPEC.tiles):
+        for s in range(SPEC.subblocks):
+            for c in range(SPEC.cap):
+                if hl_f[t, s, c] != tilemm.PAD16:
+                    b = t * tilemm.TILE + int(hl_f[t, s, c])
+                    r = s * tilemm.RSUB + int(rd_f[t, s, c])
+                    got.append((b, r))
+    want = sorted(zip(buckets.tolist(), rows.tolist()))
+    assert sorted(got) == want
+
+
+def test_forward_backward_match_oracle():
+    rng = np.random.default_rng(1)
+    buckets, rows = make_pairs(rng, 4000)
+    hl, rd, _, _ = tilemm.encode_block(buckets, rows, SPEC)
+    w = (rng.standard_normal(SPEC.nb) * 0.1).astype(np.float32)
+    dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
+    mg = np.asarray(tilemm.forward_margins(hl, rd, w, SPEC))
+    g = np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC))
+    om = tilemm.forward_margins_ref(buckets, rows, w, SPEC.block_rows)
+    og = tilemm.backward_grad_ref(buckets, rows, dual, SPEC.nb)
+    # bf16 one-hot matmuls quantize the VALUES (w, dual) to bf16; the
+    # reductions accumulate in f32
+    assert np.max(np.abs(mg - om)) <= 2e-2 * max(1, np.abs(om).max())
+    assert np.max(np.abs(g - og)) <= 2e-2 * max(1, np.abs(og).max())
+
+
+def test_overflow_spill_exact():
+    """A hot bucket past `cap` spills to the COO path and stays exact."""
+    rng = np.random.default_rng(2)
+    buckets, rows = make_pairs(rng, 3000)
+    hot = 7 * tilemm.TILE // 4          # some bucket in tile 1
+    buckets = np.concatenate([buckets, np.full(1400, hot, np.int64)])
+    rows = np.concatenate(
+        [rows, rng.integers(0, tilemm.RSUB, size=1400).astype(np.int64)])
+    hl, rd, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    assert len(ovb) > 0                  # hot bucket exceeds cap
+    cap_o = 1536
+    pad_b = np.full(cap_o, 0xFFFFFFFF, np.uint32)
+    pad_r = np.zeros(cap_o, np.uint32)
+    pad_b[:len(ovb)], pad_r[:len(ovr)] = ovb, ovr
+    w = (rng.standard_normal(SPEC.nb) * 0.1).astype(np.float32)
+    dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
+    mg = np.asarray(tilemm.forward_margins(hl, rd, w, SPEC, pad_b, pad_r))
+    g = np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC, pad_b, pad_r))
+    om = tilemm.forward_margins_ref(buckets, rows, w, SPEC.block_rows)
+    og = tilemm.backward_grad_ref(buckets, rows, dual, SPEC.nb)
+    assert np.max(np.abs(mg - om)) <= 2e-2 * max(1, np.abs(om).max())
+    assert np.max(np.abs(g - og)) <= 2e-2 * max(1, np.abs(og).max())
+
+
+def test_pad_pairs_are_noops():
+    """All-pad encoding produces zero margins and zero gradient."""
+    hl = np.full(SPEC.pairs_shape, tilemm.PAD16, np.uint16)
+    rd = np.zeros(SPEC.pairs_shape, np.uint16)
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal(SPEC.nb).astype(np.float32)
+    dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
+    assert np.all(np.asarray(tilemm.forward_margins(hl, rd, w, SPEC)) == 0)
+    assert np.all(np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC)) == 0)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        tilemm.TileSpec(nb=1000, subblocks=2, cap=128)
+    with pytest.raises(ValueError):
+        tilemm.TileSpec(nb=tilemm.TILE, subblocks=3, cap=128, group=2)
+    with pytest.raises(ValueError):
+        tilemm.TileSpec(nb=tilemm.TILE, subblocks=2, cap=100)

@@ -146,7 +146,7 @@ class AsyncSGD:
         can compute pass-level metrics over the full eval output (the
         reference evaluates AUC over the complete pass, evaluation.h:38-68,
         not a mean of per-minibatch AUCs)."""
-        if self.cfg.data_format == "crec":
+        if self.cfg.data_format in ("crec", "crec2"):
             return self._process_crec(file, part, nparts, kind, pooled)
         cfg = self.cfg
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
@@ -199,67 +199,177 @@ class AsyncSGD:
                 harvest(inflight.popleft())
         return local
 
+    def _feed(self, file: str, part: int, nparts: int, fmt: str):
+        """PackedFeed per (file, part), kept across data passes so
+        cache_device replays HBM-resident blocks instead of re-streaming
+        over the host interconnect."""
+        if not self.cfg.cache_device:
+            from wormhole_tpu.data.crec import PackedFeed
+            return PackedFeed(file, part, nparts, fmt=fmt)
+        key = (file, part, nparts, fmt)
+        feed = self._feeds.get(key) if hasattr(self, "_feeds") else None
+        if feed is None:
+            from wormhole_tpu.data.crec import PackedFeed
+            feed = PackedFeed(file, part, nparts, fmt=fmt, cache=True)
+            if not hasattr(self, "_feeds"):
+                self._feeds = {}
+            self._feeds[key] = feed
+        return feed
+
     def _process_crec(self, file: str, part: int, nparts: int,
                       kind: str, pooled: Optional[list]) -> Progress:
-        """The crec streaming fast path: packed block bytes go straight to
-        the device (PackedFeed prefetch thread overlaps transfer with
-        dispatch) and train via the store's fused dense-apply step — the
-        host does no per-row work at all (SURVEY §7 hard part (d))."""
-        from wormhole_tpu.data.crec import PackedFeed, read_header
+        """The crec/crec2 streaming fast path: packed block bytes go
+        straight to the device (PackedFeed prefetch thread overlaps
+        transfer with dispatch) — the host does no per-row work at all
+        (SURVEY §7 hard part (d)).
+
+        crec blocks run the fused dense-apply step (on-device key fold +
+        scatter); crec2 blocks run the tile-blocked MXU step
+        (ops/tilemm) whose AUC display stat comes from merged margin
+        histograms rather than per-block sorts."""
+        from wormhole_tpu.data.crec import (read_header, read_header2)
+        from wormhole_tpu.ops.metrics import auc_from_hist
         cfg = self.cfg
-        if not hasattr(self.store, "dense_train_step"):
-            raise ValueError(
-                f"store {type(self.store).__name__} has no dense-apply "
-                "step; crec streaming needs the table-backed ShardedStore")
-        info = read_header(file)
-        kb = info.block_rows * info.nnz * 4
+        fmt = cfg.data_format
+        if fmt == "crec2":
+            if not hasattr(self.store, "tile_train_step"):
+                raise ValueError(
+                    f"store {type(self.store).__name__} has no tile step; "
+                    "crec2 streaming needs the table-backed ShardedStore")
+            info = read_header2(file)
+            if info.nb != cfg.num_buckets:
+                raise ValueError(
+                    f"{file}: crec2 was written for num_buckets={info.nb} "
+                    f"but config says {cfg.num_buckets} (the tile grouping "
+                    "is bucket-count specific)")
+            lab_off = 0  # crec2 blocks are typed dicts; labels ride as-is
+        else:
+            if not hasattr(self.store, "dense_train_step"):
+                raise ValueError(
+                    f"store {type(self.store).__name__} has no dense-apply "
+                    "step; crec streaming needs the table-backed "
+                    "ShardedStore")
+            info = read_header(file)
+            lab_off = info.block_rows * info.nnz * 4
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
+        tau_cap = float(max(cfg.max_delay - 1, 0))
         inflight: deque = deque()
+        pending: list = []   # device metric tuples awaiting one batched D2H
+        hist_tot = [np.zeros(512), np.zeros(512)]  # running pos/neg hists
         local = Progress()
 
-        def harvest(item) -> None:
-            metrics, labels_u8 = item
-            metrics = jax.block_until_ready(metrics)
-            objv, num_ex, a, acc = (float(np.asarray(m))
-                                    for m in metrics[:4])
-            local.objv += objv
-            local.num_ex += int(num_ex)
-            local.count += 1
-            local.auc += a
-            local.acc += acc
-            if kind == TRAIN and len(metrics) > 4:
-                local.wdelta2 += float(np.asarray(metrics[4]))
-            if pooled is not None and labels_u8 is not None:
-                margin = np.asarray(metrics[4])
-                real = labels_u8 != 255
-                pooled.append((margin[real],
-                               np.minimum(labels_u8[real], 1)
-                               .astype(np.float32),
-                               np.ones(int(real.sum()), np.float32)))
+        def drain_pending() -> None:
+            """Fetch ALL pending metrics with minimal host<->device round
+            trips — per-leaf fetches cost one round trip each, which
+            dominates the steady-state loop on a high-latency transport
+            (the axon tunnel; round-3 finding). The crec2 train step packs
+            its metrics into ONE vector, so a whole window drains as a
+            single stacked-buffer fetch."""
+            if not pending:
+                return
+            if fmt == "crec2" and kind == TRAIN:
+                import jax.numpy as jnp
+                rows = jax.device_get(jnp.stack([p[0] for p in pending]))
+                for row in rows:
+                    local.objv += float(row[0])
+                    local.num_ex += int(row[1])
+                    local.count += 1
+                    local.acc += float(row[2])
+                    local.wdelta2 += float(row[3])
+                    bins = (len(row) - 4) // 2
+                    hist_tot[0] += row[4:4 + bins]
+                    hist_tot[1] += row[4 + bins:]
+                # pass-level AUC from the RUNNING histogram totals; kept
+                # as auc*count so Progress's auc/count display (and merge
+                # across parts) reproduces the pass-level number
+                local.auc = (auc_from_hist(*hist_tot) * local.count)
+                pending.clear()
+                self._display(local)
+                return
+            fetched = jax.device_get([p[0] for p in pending])
+            for (mdev, labels_u8), metrics in zip(pending, fetched):
+                local.objv += float(metrics[0])
+                local.num_ex += int(metrics[1])
+                local.count += 1
+                if fmt == "crec2":
+                    local.acc += float(metrics[2])
+                    local.auc += auc_from_hist(metrics[3], metrics[4])
+                    margin_ix = 5  # eval: margins ride in slot 5
+                else:
+                    local.auc += float(metrics[2])
+                    local.acc += float(metrics[3])
+                    margin_ix = 4
+                if kind == TRAIN and len(metrics) > margin_ix:
+                    local.wdelta2 += float(metrics[margin_ix])
+                if pooled is not None and labels_u8 is not None:
+                    margin = np.asarray(metrics[margin_ix])
+                    real = labels_u8 != 255
+                    pooled.append((margin[real],
+                                   np.minimum(labels_u8[real], 1)
+                                   .astype(np.float32),
+                                   np.ones(int(real.sum()), np.float32)))
+            pending.clear()
             if kind == TRAIN:
                 self._display(local)
 
+        def harvest(item) -> None:
+            m = item[0]
+            jax.block_until_ready(m[0] if isinstance(m, tuple) else m)
+            pending.append(item)
+            if kind == TRAIN \
+                    and time.time() - self._last_disp >= self.cfg.disp_itv:
+                drain_pending()
+
+        def _labels_of(host) -> np.ndarray:
+            if isinstance(host, dict):
+                return host["labels"].copy()
+            if host.nbytes == info.block_rows:
+                return host            # cached item: already labels-only
+            return host[lab_off:lab_off + info.block_rows].copy()
+
         pfx = "" if kind == TRAIN else "eval_"
-        feed = PackedFeed(file, part, nparts)
+        feed = self._feed(file, part, nparts, fmt)
+        put_before = feed.put_time
+        if getattr(feed, "_cache_full", False):
+            # HBM-resident replay: single-device steps serialize on the
+            # donated slots chain anyway, so the staleness window only
+            # throttles host buffering of in-flight blocks — and cached
+            # blocks are already resident. Each gate costs a host<->device
+            # round trip (expensive on a tunneled transport), so skip
+            # intra-pass gating and sync once at the end.
+            max_delay = 1 << 30
         for dev, host, rows in feed:
             with self.timer.scope(pfx + "wait"):
                 while len(inflight) > max(max_delay - 1, 0):
                     harvest(inflight.popleft())
             with self.timer.scope(pfx + "dispatch"):
-                if kind == TRAIN:
+                if fmt == "crec2":
+                    if kind == TRAIN:
+                        m = self.store.tile_train_step(
+                            dev, info,
+                            tau=min(float(len(inflight)), tau_cap))
+                        inflight.append((m, None))
+                    else:
+                        m = self.store.tile_eval_step(dev, info)
+                        inflight.append((m, _labels_of(host)))
+                elif kind == TRAIN:
                     m = self.store.dense_train_step(
                         dev, info.block_rows, info.nnz,
-                        tau=float(len(inflight)))
+                        tau=min(float(len(inflight)), tau_cap),
+                        donate_packed=not cfg.cache_device)
                     inflight.append((m, None))
                 else:
                     m = self.store.dense_eval_step(dev, info.block_rows,
                                                    info.nnz)
-                    inflight.append(
-                        (m, host[kb:kb + info.block_rows].copy()))
+                    inflight.append((m, _labels_of(host)))
         with self.timer.scope(pfx + "wait"):
+            # no per-item block_until_ready here: drain_pending's
+            # device_get synchronizes, and each block_until_ready is a
+            # full round trip on a tunneled transport
             while inflight:
-                harvest(inflight.popleft())
-        self.timer.add(pfx + "put", feed.put_time)
+                pending.append(inflight.popleft())
+            drain_pending()
+        self.timer.add(pfx + "put", feed.put_time - put_before)
         return local
 
     @staticmethod

@@ -255,6 +255,96 @@ class ShardedStore(TableCheckpoint):
         return self._dense_step(block_rows, nnz, "eval", False)(
             self.slots, packed)
 
+    # -- tile-blocked MXU step: the crec2 streaming fast path ---------------
+    #
+    # One fused program over a tile-grouped crec2 block (data/crec.py v2 +
+    # ops/tilemm.py): the block bytes ARE the kernel operands — digit-
+    # encoded (bucket, row) pairs grouped by 16K-bucket tile, so pull and
+    # push both run as dense one-hot matmuls on the MXU instead of
+    # serialized gather/scatter (see tilemm module docstring). Same
+    # dense-apply semantics as the v1 crec path: the handle sweeps the
+    # whole table, so it needs FTRL or a penalty-free handle
+    # (supports_dense_apply).
+
+    def _tile_step(self, info, kind: str):
+        key = (info, kind)
+        fn = getattr(self, "_tile_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        if kind == "train" and not supports_dense_apply(self.handle):
+            raise ValueError(
+                "dense apply needs FTRL or a penalty-free handle "
+                "(zero-grad pushes must be identity); use the sparse path")
+        from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.metrics import margin_hist
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+        spec = info.spec
+        oc = info.ovf_cap
+
+        def decode(block):
+            lab_u8 = block["labels"]
+            row_mask = (lab_u8 != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
+            ovf_b = block["ovf_b"] if oc else None
+            ovf_r = block["ovf_r"] if oc else None
+            return block["hl"], block["rd"], labels, row_mask, ovf_b, ovf_r
+
+        if kind == "train":
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(slots, block, t, tau):
+                hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
+                w = handle.weights(slots)
+                margin = tilemm.forward_margins(hl, rd, w, spec,
+                                                ovf_b, ovf_r)
+                objv = objv_fn(margin, labels, row_mask)
+                dual = dual_fn(margin, labels, row_mask)
+                grad = tilemm.backward_grad(hl, rd, dual, spec,
+                                            ovf_b, ovf_r)
+                new = handle.push(slots, grad, t, tau)
+                num_ex = jnp.sum(row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                pos, neg = margin_hist(labels, margin, row_mask)
+                d0 = new[:, 0] - slots[:, 0]
+                # ONE packed metrics buffer per step: the harvest loop
+                # stacks pending blocks' metrics and fetches a single
+                # device buffer — per-leaf fetches are one host round
+                # trip each, which dominates on a tunneled transport
+                packed = jnp.concatenate([
+                    jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
+                    pos, neg])
+                return new, packed
+        else:
+            @jax.jit
+            def step(slots, block):
+                hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
+                w = handle.weights(slots)
+                margin = tilemm.forward_margins(hl, rd, w, spec,
+                                                ovf_b, ovf_r)
+                objv = objv_fn(margin, labels, row_mask)
+                num_ex = jnp.sum(row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                pos, neg = margin_hist(labels, margin, row_mask)
+                return objv, num_ex, acc, pos, neg, margin
+
+        if not hasattr(self, "_tile_cache"):
+            self._tile_cache = {}
+        self._tile_cache[key] = step
+        return step
+
+    def tile_train_step(self, block: dict, info, tau: float = 0.0):
+        """Fused crec2-block step over a typed block dict (crec.block2_views
+        shipped to device); returns (objv, num_ex, acc, pos_hist, neg_hist,
+        wdelta2) — AUC comes from the merged histograms."""
+        step = self._tile_step(info, "train")
+        self.slots, metrics = step(
+            self.slots, block, jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def tile_eval_step(self, block: dict, info):
+        return self._tile_step(info, "eval")(self.slots, block)
+
     # -- the ZPush/ZPull surface --------------------------------------------
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):

@@ -35,17 +35,21 @@ class Text2RecConfig:
     format: str = "criteo"
     part: int = 0
     nparts: int = 1
-    # --- crec output (out_format=crec) ---
-    out_format: str = "recordio"  # recordio | crec
+    # --- crec output (out_format=crec|crec2) ---
+    out_format: str = "recordio"  # recordio | crec | crec2
     nnz: int = 0                  # crec fixed row width; 0 = 39 for criteo
-    block_rows: int = 100_000     # crec block size (the device-put unit)
+    block_rows: int = 100_000     # crec v1 block size (the device-put unit)
+    # --- crec2 (tile-grouped MXU layout; ops/tilemm.py) ---
+    num_buckets: int = 1 << 22    # model bucket count the tiles are built for
+    subblocks: int = 12           # 8192-row subblocks per block
+    ovf_cap: int = 1024           # per-block overflow (skew) capacity
 
 
 def convert(cfg: Text2RecConfig) -> int:
     """Returns number of rows written."""
     if not cfg.input or not cfg.output:
         raise ValueError("need input=<uri> output=<uri>")
-    if cfg.out_format == "crec":
+    if cfg.out_format in ("crec", "crec2"):
         return convert_crec(cfg)
     src = InputSplit(cfg.input, cfg.part, cfg.nparts, split_type="text")
     rows = 0
@@ -69,9 +73,14 @@ def convert_crec(cfg: Text2RecConfig) -> int:
     data/crec.py): 64-bit parser ids are mapped onto u32 (key64_to_key32),
     rows are truncated/sentinel-padded to the fixed ``nnz`` width, labels
     are binarized. Values are dropped — crec is for the binary-feature
-    streaming path (criteo/adfea); use recordio for valued data."""
+    streaming path (criteo/adfea); use recordio for valued data.
+
+    ``out_format=crec2`` additionally folds keys to hashed buckets and
+    tile-groups each block offline (ops/tilemm.py) so the train step runs
+    as dense MXU matmuls — the fastest path; the file is then specific to
+    ``num_buckets``."""
     import numpy as np
-    from wormhole_tpu.data.crec import CRecWriter, SENTINEL_KEY
+    from wormhole_tpu.data.crec import CRec2Writer, CRecWriter, SENTINEL_KEY
     from wormhole_tpu.data.hashing import key64_to_key32
     nnz = cfg.nnz or (39 if cfg.format == "criteo" else 0)
     if not nnz:
@@ -80,7 +89,12 @@ def convert_crec(cfg: Text2RecConfig) -> int:
     rows = 0
     trunc = 0
     t0 = get_time()
-    with CRecWriter(cfg.output, nnz=nnz, block_rows=cfg.block_rows) as w:
+    if cfg.out_format == "crec2":
+        writer = CRec2Writer(cfg.output, nnz=nnz, nb=cfg.num_buckets,
+                             subblocks=cfg.subblocks, ovf_cap=cfg.ovf_cap)
+    else:
+        writer = CRecWriter(cfg.output, nnz=nnz, block_rows=cfg.block_rows)
+    with writer as w:
         for blk in iter_blocks(src, cfg.format):
             n = blk.size
             k32 = key64_to_key32(blk.index)

@@ -104,6 +104,9 @@ class Config:
                                 # max_nnz) for multi-host sync training,
                                 # where batch shapes must match across hosts
     mesh_shape: str = ""        # e.g. "data:4,model:2"; empty = all devices on "data"
+    cache_device: bool = False  # crec/crec2: keep streamed blocks resident in
+                                # HBM and replay them on later data passes
+                                # (dataset must fit device memory)
     param_dtype: str = "float32"
     seed: int = 0
     checkpoint_dir: str = ""

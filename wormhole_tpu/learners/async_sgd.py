@@ -327,6 +327,9 @@ class AsyncSGD:
                 return host            # cached item: already labels-only
             return host[lab_off:lab_off + info.block_rows].copy()
 
+        if fmt == "crec2" and self.rt.mesh.size > 1:
+            return self._process_crec2_mesh(file, part, nparts, kind,
+                                            pooled, info, local)
         pfx = "" if kind == TRAIN else "eval_"
         feed = self._feed(file, part, nparts, fmt)
         put_before = feed.put_time
@@ -372,6 +375,113 @@ class AsyncSGD:
         self.timer.add(pfx + "put", feed.put_time - put_before)
         return local
 
+    def _process_crec2_mesh(self, file: str, part: int, nparts: int,
+                            kind: str, pooled: Optional[list],
+                            info, local: Progress) -> Progress:
+        """crec2 over a multi-device mesh: feed blocks in groups of
+        ``data_axis_size`` (stacked on a leading axis; short tails pad
+        with all-PAD blocks) through the shard_map tile step — model axis
+        shards the bucket tiles, data axis shards blocks."""
+        from wormhole_tpu.data.crec import PackedFeed
+        from wormhole_tpu.ops.metrics import auc_from_hist
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-PROCESS crec2 training is not wired yet; use the "
+                "sparse formats for multihost runs or a single process "
+                "with a multi-device mesh")
+        D = self.rt.data_axis_size
+        spec = info.spec
+        pfx = "" if kind == TRAIN else "eval_"
+        # no-op device_put: the mesh step jits host arrays straight onto
+        # their (data, model)-sharded layout
+        feed = PackedFeed(file, part, nparts, fmt="crec2",
+                          device_put=lambda x: x)
+        group: list = []
+
+        # shared pad arrays — building them per dispatch would allocate
+        # megabytes of throwaway uint16 per step in the hot loop
+        ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF, np.uint32)
+        ovf_pad_r = np.zeros(max(info.ovf_cap, 1), np.uint32)
+        hl_pad = np.full(spec.pairs_shape, np.uint16(0xFFFF), np.uint16)
+        rd_pad = np.zeros(spec.pairs_shape, np.uint16)
+        lab_pad = np.full(info.block_rows, 255, np.uint8)
+
+        def pad_block():
+            return {"hl": hl_pad, "rd": rd_pad, "labels": lab_pad,
+                    "ovf_b": ovf_pad_b, "ovf_r": ovf_pad_r}
+
+        pending: list = []   # train metric vectors awaiting one batched D2H
+        hist_tot = [np.zeros(512), np.zeros(512)]
+
+        def drain_pending() -> None:
+            """One stacked-buffer fetch for the whole window (per-step
+            device_get is a full round trip on a tunneled transport and
+            would serialize host against device)."""
+            if not pending:
+                return
+            import jax.numpy as jnp
+            rows = jax.device_get(jnp.stack(pending))
+            for row in rows:
+                local.objv += float(row[0])
+                local.num_ex += int(row[1])
+                local.count += 1
+                local.acc += float(row[2])
+                local.wdelta2 += float(row[3])
+                bins = (len(row) - 4) // 2
+                hist_tot[0] += row[4:4 + bins]
+                hist_tot[1] += row[4 + bins:]
+            # pass-level AUC from running histogram totals, stored as
+            # auc*count so Progress's auc/count display stays correct
+            local.auc = auc_from_hist(*hist_tot) * local.count
+            pending.clear()
+            self._display(local)
+
+        def dispatch(views_list):
+            while len(views_list) < D:
+                views_list.append(pad_block())
+            blocks = {k: np.stack([v[k] for v in views_list])
+                      for k in ("hl", "rd", "labels")}
+            blocks["ovf_b"] = np.stack(
+                [v.get("ovf_b", ovf_pad_b) for v in views_list])
+            blocks["ovf_r"] = np.stack(
+                [v.get("ovf_r", ovf_pad_r) for v in views_list])
+            with self.timer.scope(pfx + "dispatch"):
+                if kind == TRAIN:
+                    pending.append(
+                        self.store.tile_train_step_mesh(blocks, info))
+                    if time.time() - self._last_disp >= self.cfg.disp_itv:
+                        with self.timer.scope(pfx + "wait"):
+                            drain_pending()
+                else:
+                    m = self.store.tile_eval_step_mesh(blocks, info)
+                    local.objv += float(np.asarray(m[0]))
+                    local.num_ex += int(np.asarray(m[1]))
+                    local.count += 1
+                    local.acc += float(np.asarray(m[2]))
+                    local.auc += auc_from_hist(np.asarray(m[3]),
+                                               np.asarray(m[4]))
+                    if pooled is not None:
+                        margins = np.asarray(jax.device_get(m[5]))
+                        labs = np.concatenate(
+                            [v["labels"] for v in views_list])
+                        real = labs != 255
+                        pooled.append(
+                            (margins[real],
+                             np.minimum(labs[real], 1).astype(np.float32),
+                             np.ones(int(real.sum()), np.float32)))
+
+        for dev, _host, _rows in feed:
+            group.append(dev)
+            if len(group) == D:
+                dispatch(group)
+                group = []
+        if group:
+            dispatch(group)
+        with self.timer.scope(pfx + "wait"):
+            drain_pending()
+        self.timer.add(pfx + "put", feed.put_time)
+        return local
+
     @staticmethod
     def _real_rows(batch) -> np.ndarray:
         """Per-row (real, weight) for pooled eval: real rows are the first
@@ -395,20 +505,11 @@ class AsyncSGD:
         # semantics: version = completed data passes). The reference's
         # async model dies with a server; here the whole sharded state —
         # including optimizer accumulators — survives a restart.
+        # (Multi-process resume lives in run_multihost, which this method
+        # already dispatched to above.)
         start_pass = 0
         if cfg.checkpoint_dir and self._ckpt_ok():
             start_pass, state = self.ckpt.load(self.store.state_pytree())
-            if jax.process_count() > 1:
-                # ranks must agree on the resume point even when the
-                # checkpoint dir is not shared: rank 0's view wins. The
-                # scalar broadcast goes first so the (large) state is only
-                # shipped when there is actually something to resume.
-                from wormhole_tpu.parallel.collectives import broadcast_tree
-                start_pass = int(broadcast_tree(np.int64(start_pass),
-                                                self.rt.mesh))
-                if start_pass:
-                    state = broadcast_tree(
-                        jax.tree.map(np.asarray, state), self.rt.mesh)
             if start_pass:
                 self.store.restore_pytree(state)
                 log.info("resumed at data pass %d", start_pass)
@@ -459,6 +560,63 @@ class AsyncSGD:
     # pre-step state — exactly the reference's async-apply semantics.
     # Shapes must match across hosts, so max_nnz and key_pad are required
     # static config here.
+    #
+    # Work distribution is DYNAMIC (the reference's work-stealing
+    # scheduler, async_sgd.h:245-348 + workload_pool.h): every host runs an
+    # identical REPLICA of the WorkloadPool and applies the same
+    # finish/claim transitions, driven by one small allgather of per-host
+    # (finished_part, need_part) state per global step — a host that
+    # exhausts a short part claims the next unassigned part while others
+    # keep streaming theirs, with no scheduler process or RPC. Straggler
+    # re-execution is disabled in the replica (it keys on wall-clock
+    # durations, which differ across hosts and would desynchronize the
+    # replicas; lockstep SPMD steps cannot straggle at the part level
+    # anyway). Host failure is a JAX job failure — recovery is
+    # restart-from-checkpoint (ShardCheckpointer, saved every pass), the
+    # same model rabit uses for its BSP apps.
+
+    def _host_slot(self) -> int:
+        """This host's block position along the mesh DATA axis, derived
+        from the mesh itself — NOT assumed equal to process rank order
+        (meshes built from reordered device lists break that assumption).
+
+        Validates what multi-host batch assembly actually requires: each
+        data-axis index is process-uniform across the model axis, and each
+        process owns one contiguous run of data-axis indices."""
+        mesh = self.rt.mesh
+        dpa = self.rt.data_axis_size
+        devs = mesh.devices.reshape(dpa, -1)
+        procs = []
+        for i in range(dpa):
+            row = {int(d.process_index) for d in devs[i]}
+            if len(row) != 1:
+                raise ValueError(
+                    f"data-axis index {i} spans processes {sorted(row)}; "
+                    "multi-host training needs the model axis to stay "
+                    "within a host (choose mesh_shape accordingly)")
+            procs.append(row.pop())
+        order = list(dict.fromkeys(procs))
+        if len(order) != self.rt.world:
+            raise ValueError(
+                f"data axis covers {len(order)} processes but world is "
+                f"{self.rt.world}")
+        for p in set(procs):
+            idx = [i for i, q in enumerate(procs) if q == p]
+            if idx != list(range(idx[0], idx[-1] + 1)):
+                raise ValueError(
+                    f"process {p}'s data-axis indices {idx} are not "
+                    "contiguous; rebuild the mesh in process order")
+        return order.index(self.rt.rank)
+
+    @staticmethod
+    def _my_shard_rows(arr) -> np.ndarray:
+        """This process's rows of a data-axis-sharded global array
+        (deduplicating model-axis replicas)."""
+        parts = {}
+        for s in arr.addressable_shards:
+            start = s.index[0].start or 0
+            parts[start] = np.asarray(s.data)
+        return np.concatenate([parts[k] for k in sorted(parts)])
 
     def _global_batch(self, batch):
         """Assemble per-host batches into one data-axis-sharded batch."""
@@ -467,7 +625,7 @@ class AsyncSGD:
         from wormhole_tpu.data.feed import SparseBatch
         kpad = self.cfg.key_pad
         batch = SparseBatch(
-            cols=batch.cols + np.int32(self.rt.rank * kpad),
+            cols=batch.cols + np.int32(self._slot * kpad),
             vals=batch.vals, labels=batch.labels, row_mask=batch.row_mask,
             uniq_keys=batch.uniq_keys, key_mask=batch.key_mask)
         return multihost_utils.host_local_array_to_global_array(
@@ -484,67 +642,198 @@ class AsyncSGD:
             uniq_keys=np.zeros(cfg.key_pad, np.int32),
             key_mask=np.zeros(cfg.key_pad, np.float32))
 
-    def run_multihost(self) -> Progress:
-        """Synchronized multi-host passes: static rank/world partition of
-        every matched file; hosts that exhaust their shard first feed
-        masked empty batches until everyone is done (the per-step
-        have-data allreduce keeps the collectives aligned)."""
-        from wormhole_tpu.data.stream import list_files
+    def _multihost_pass(self, pattern: str, kind: str,
+                        pooled: Optional[list] = None) -> Progress:
+        """One synchronized pass over ``pattern`` with the replicated
+        dynamic pool. The returned Progress is GLOBAL — every metric comes
+        out of the global step, so all hosts compute identical values."""
+        from jax.experimental import multihost_utils
         from wormhole_tpu.parallel.collectives import allreduce_tree
         cfg = self.cfg
-        if not (cfg.max_nnz and cfg.key_pad):
-            raise ValueError("multi-host sync training needs static "
-                             "max_nnz= and key_pad= config")
-        if cfg.test_data:
-            raise NotImplementedError(
-                "TEST/predict workloads are single-host for now; run "
-                "task=predict separately on the saved model")
-        if cfg.model_in:
-            # every host reads the same file → identical warm-start table
-            self.store.load_model(cfg.model_in)
-            log.info("warm start from %s", cfg.model_in)
-        self._max_nnz = cfg.max_nnz
-        files = [fi.path for fi in list_files(cfg.train_data)]
-        if not files:
-            raise FileNotFoundError(cfg.train_data)
-        print(Progress.HEADER)
+        world = self.rt.world
+        pool = WorkloadPool(straggler_factor=float("inf"))
+        pool.add(pattern, cfg.num_parts_per_file, kind)
+        my_it = None
+        my_wl = None
+        drained = False
+        finished_id = -1
         local = Progress()
+        inflight: deque = deque()
+        pfx = "" if kind == TRAIN else "eval_"
+        tau_cap = float(max(cfg.max_delay - 1, 0))
 
-        def harvest(metrics):
-            vals = [float(np.asarray(m)) for m in metrics]
+        def harvest(metrics) -> None:
+            vals = [float(v) for v in np.asarray(
+                jax.device_get(metrics[:4]))]
             local.objv += vals[0]
             local.num_ex += int(vals[1])
             local.count += 1
             local.auc += vals[2]
             local.acc += vals[3]
-            self._display(local)
+            if kind == TRAIN:
+                self._display(local)
 
-        inflight: deque = deque()
-        for _ in range(cfg.max_data_pass):
-            def local_batches():
-                for f in files:
-                    yield from self._batches(f, self.rt.rank,
-                                             self.rt.world)
-            it = local_batches()
-            while True:
-                blk = next(it, None)
-                have = int(allreduce_tree(np.int64(blk is not None),
-                                          self.rt.mesh, "sum"))
-                if have == 0:
+        while True:
+            blk = None
+            if my_it is not None:
+                with self.timer.scope(pfx + "parse"):
+                    blk = next(my_it, None)
+                if blk is None:
+                    finished_id = my_wl.id
+                    my_it = None
+            need = my_it is None and not drained
+            # one exchange per global step: (finished part, need, drained)
+            status = multihost_utils.process_allgather(
+                np.asarray([finished_id, int(need), int(drained)],
+                           np.int64))
+            finished_id = -1
+            # identical pool transitions on every replica, in rank order
+            for r in range(world):
+                if status[r, 0] >= 0:
+                    pool.finish(int(status[r, 0]))
+            for r in range(world):
+                if status[r, 1]:
+                    wl = pool.get(f"proc{r}")
+                    if r == self.rt.rank:
+                        my_wl = wl
+            if need:
+                if my_wl is None:
+                    drained = True
+                else:
+                    my_it = self._batches(my_wl.file, my_wl.part,
+                                          my_wl.nparts, pfx)
+                    with self.timer.scope(pfx + "parse"):
+                        blk = next(my_it, None)
+                    if blk is None:       # empty part: finish next round
+                        finished_id = my_wl.id
+                        my_it = None
+            have = int(allreduce_tree(np.int64(blk is not None),
+                                      self.rt.mesh, "sum"))
+            if have == 0:
+                if bool(np.all(status[:, 2])) and not need:
                     break
-                batch = self._global_batch(
-                    blk if blk is not None else self._empty_local_batch())
-                inflight.append(
-                    self.store.train_step(batch, tau=float(len(inflight))))
-                # cap in-flight steps at max_delay (0 → synchronous)
+                continue
+            batch = blk if blk is not None else self._empty_local_batch()
+            gb = self._global_batch(batch)
+            with self.timer.scope(pfx + "dispatch"):
+                if kind == TRAIN:
+                    inflight.append(self.store.train_step(
+                        gb, tau=min(float(len(inflight)), tau_cap)))
+                else:
+                    m = self.store.eval_step(gb)
+                    harvest(m)
+                    if pooled is not None:
+                        margins = self._my_shard_rows(m[4])
+                        keep = self._real_rows(batch)
+                        real = keep >= 0
+                        pooled.append((margins[real],
+                                       np.asarray(batch.labels)[real],
+                                       np.maximum(keep[real], 0.0)))
+            with self.timer.scope(pfx + "wait"):
                 while len(inflight) > cfg.max_delay:
                     harvest(jax.block_until_ready(inflight.popleft()))
+        with self.timer.scope(pfx + "wait"):
             while inflight:
                 harvest(jax.block_until_ready(inflight.popleft()))
-        self.progress.merge(local)
+        return local
+
+    def run_multihost(self) -> Progress:
+        """Multi-host scheduler loop: dynamic workload pool, per-pass
+        sharded checkpoint/resume, validation passes, divergence kill
+        switch, predict — the full AsyncSGDScheduler surface
+        (async_sgd.h:245-348) in SPMD form."""
+        from wormhole_tpu.parallel.checkpoint import ShardCheckpointer
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.ops.metrics import auc_np
+        cfg = self.cfg
+        if cfg.data_format in ("crec", "crec2"):
+            raise NotImplementedError(
+                "multi-PROCESS crec/crec2 training is not wired yet: use "
+                "sparse/text formats across hosts, or crec2 on a single "
+                "process with a multi-device mesh (the shard_map tile "
+                "step)")
+        if not (cfg.max_nnz and cfg.key_pad):
+            raise ValueError("multi-host sync training needs static "
+                             "max_nnz= and key_pad= config")
+        self._slot = self._host_slot()
+        self._max_nnz = cfg.max_nnz
+        ckpt = (ShardCheckpointer(cfg.checkpoint_dir)
+                if cfg.checkpoint_dir else None)
+        start_pass = 0
+        if ckpt is not None:
+            # ranks must agree on the resume point even when the
+            # checkpoint dir is not shared: the slowest view wins
+            ver = int(allreduce_tree(np.int64(ckpt.latest_version()),
+                                     self.rt.mesh, "min"))
+            if ver:
+                _, state = ckpt.load(self.store.state_pytree(),
+                                     version=ver)
+                self.store.restore_pytree(state)
+                start_pass = ver
+                log.info("resumed at data pass %d", start_pass)
+        if not start_pass and cfg.model_in:
+            # every host reads the same file → identical warm-start table
+            self.store.load_model(cfg.model_in)
+            log.info("warm start from %s", cfg.model_in)
+        if self.rt.rank == 0:
+            print(Progress.HEADER)
+        for data_pass in range(start_pass, cfg.max_data_pass):
+            prog = self._multihost_pass(cfg.train_data, TRAIN)
+            self.progress.merge(prog)
+            self._check_divergence(prog)
+            if ckpt is not None:
+                self.ckpt_version = data_pass + 1
+                ckpt.save(data_pass + 1, self.store.state_pytree())
+            if cfg.val_data:
+                pooled: list = []
+                vp = self._multihost_pass(cfg.val_data, VAL, pooled)
+                pass_auc = self._allreduce_pooled_auc(pooled)
+                n = max(vp.num_ex, 1)
+                log.info("pass %d validation: objv=%.6f auc=%.6f "
+                         "acc=%.6f", data_pass, vp.objv / n, pass_auc,
+                         vp.acc / max(vp.count, 1))
+        if cfg.test_data:
+            from wormhole_tpu.sched.workload_pool import TEST
+            pooled = []
+            self._multihost_pass(cfg.test_data, TEST, pooled)
+            self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
         if cfg.model_out:
             self.store.save_model(cfg.model_out, self.rt.rank)
+        if self.timer.totals:
+            log.info("pipeline profile:\n%s", self.timer.report())
         return self.progress
+
+    def _allreduce_pooled_auc(self, pooled: list) -> float:
+        """Pass-level AUC across hosts without gathering margins: each
+        host bins its own rows' (margin, label, weight) into pos/neg
+        histograms; the histograms sum across hosts (dist_monitor.h
+        merged-progress semantics, exact up to binning)."""
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.ops.metrics import auc_from_hist
+        bins, lo, hi = 512, -8.0, 8.0
+        pos = np.zeros(bins)
+        neg = np.zeros(bins)
+        for margins, labels, weights in pooled:
+            b = (np.clip((margins - lo) / (hi - lo), 0, 1)
+                 * (bins - 1)).astype(np.int64)
+            np.add.at(pos, b, (labels > 0.5) * weights)
+            np.add.at(neg, b, (labels <= 0.5) * weights)
+        pos = np.asarray(allreduce_tree(pos, self.rt.mesh, "sum"))
+        neg = np.asarray(allreduce_tree(neg, self.rt.mesh, "sum"))
+        return auc_from_hist(pos, neg)
+
+    def _write_preds(self, pooled: list, out_path: str) -> None:
+        from wormhole_tpu.data.stream import open_stream
+        margins = (np.concatenate([p[0] for p in pooled])
+                   if pooled else np.zeros(0, np.float32))
+        if self.cfg.loss.value == "logit":
+            preds = 1.0 / (1.0 + np.exp(-margins))
+        else:
+            preds = margins
+        with open_stream(out_path, "w") as f:
+            for p in preds:
+                f.write(f"{p:.6g}\n")
+        log.info("wrote %d predictions to %s", len(preds), out_path)
 
     def _ckpt_ok(self) -> bool:
         """Checkpointing requires fully host-addressable state: parameter
@@ -597,7 +886,6 @@ class AsyncSGD:
         the test data, write one prediction per real row to ``pred_out`` —
         σ(margin) for logit loss (linear.h MarginToPred), the raw margin
         otherwise."""
-        from wormhole_tpu.data.stream import open_stream
         from wormhole_tpu.sched.workload_pool import TEST
         if not out_path:
             raise ValueError("test_data set but pred_out empty")
@@ -610,22 +898,13 @@ class AsyncSGD:
                 break
             self.process(wl.file, wl.part, wl.nparts, TEST, pooled=pooled)
             pool.finish(wl.id)
-        margins = (np.concatenate([p[0] for p in pooled])
-                   if pooled else np.zeros(0, np.float32))
-        if self.cfg.loss.value == "logit":
-            preds = 1.0 / (1.0 + np.exp(-margins))
-        else:
-            preds = margins
-        with open_stream(out_path, "w") as f:
-            for p in preds:
-                f.write(f"{p:.6g}\n")
-        log.info("wrote %d predictions to %s", len(preds), out_path)
+        self._write_preds(pooled, out_path)
 
     # -- observability ------------------------------------------------------
 
     def _display(self, local: Progress) -> None:
         now = time.time()
-        if now - self._last_disp < self.cfg.disp_itv:
+        if now - self._last_disp < self.cfg.disp_itv or self.rt.rank != 0:
             return
         self._last_disp = now
         snap = Progress(self.progress.fvec + local.fvec,

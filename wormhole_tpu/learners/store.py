@@ -22,6 +22,7 @@ the scatter, halving-to-quartering the collective bytes.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Tuple
@@ -36,6 +37,29 @@ from wormhole_tpu.learners.handles import Handle
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
+
+
+def put_like(template: jax.Array, full: np.ndarray) -> jax.Array:
+    """Place a full host-side array like ``template`` — including when the
+    template is sharded ACROSS processes (model axis spanning hosts), where
+    a plain device_put is illegal: each process contributes its local rows
+    via make_array_from_process_local_data."""
+    full = np.asarray(full)
+    if getattr(template, "is_fully_addressable", True):
+        sharding = getattr(template, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            # the template was an uncommitted local array (single device /
+            # replicated-per-process); committing it to its current device
+            # would make later mixing with mesh-global batch arrays
+            # illegal, so stay uncommitted too
+            return jnp.asarray(full)
+        return jax.device_put(jnp.asarray(full), sharding)
+    parts = {}
+    for s in template.addressable_shards:
+        start = s.index[0].start or 0
+        parts[start] = full[s.index]
+    local = np.concatenate([parts[k] for k in sorted(parts)])
+    return jax.make_array_from_process_local_data(template.sharding, local)
 
 
 def shard_param_table(arr: jax.Array,
@@ -102,8 +126,11 @@ class TableCheckpoint:
         return {"slots": self.slots, "t": np.int64(self.t)}
 
     def restore_pytree(self, state) -> None:
-        self.slots = jax.device_put(jnp.asarray(state["slots"]),
-                                    self.slots.sharding)
+        slots = state["slots"]
+        if isinstance(slots, jax.Array) and not slots.is_fully_addressable:
+            self.slots = slots       # already a global array (ShardCkpt)
+        else:
+            self.slots = put_like(self.slots, np.asarray(slots))
         self.t = int(state["t"])
 
 
@@ -331,6 +358,135 @@ class ShardedStore(TableCheckpoint):
         self._tile_cache[key] = step
         return step
 
+    # -- tile step over a data x model mesh ---------------------------------
+    #
+    # The distributed form of the crec2 path: the MODEL axis shards the
+    # bucket tiles (each shard runs the tile kernels over its own tile
+    # range — the ps-lite key-range server shard, reborn as a mesh
+    # dimension), the DATA axis shards whole blocks (one per data index).
+    # Partial margins psum over model; gradients psum over data; the handle
+    # applies shard-locally. Inputs arrive stacked on a leading data axis.
+
+    def _tile_step_mesh(self, info, kind: str):
+        key = (info, kind, "mesh")
+        fn = getattr(self, "_tile_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        if kind == "train" and not supports_dense_apply(self.handle):
+            raise ValueError(
+                "dense apply needs FTRL or a penalty-free handle "
+                "(zero-grad pushes must be identity); use the sparse path")
+        from jax.experimental.shard_map import shard_map
+        from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.metrics import margin_hist
+        from wormhole_tpu.parallel.mesh import DATA_AXIS
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+        mesh = self.rt.mesh
+        dpa = self.rt.data_axis_size
+        m = self.rt.model_axis_size
+        spec = info.spec
+        if spec.nb % (tilemm.TILE * m):
+            raise ValueError(
+                f"nb {spec.nb} not shardable over model axis {m}")
+        nb_local = spec.nb // m
+        spec_local = tilemm.make_spec(nb_local, spec.subblocks, spec.cap)
+        oc, R = info.ovf_cap, info.block_rows
+        have_model = m > 1 and MODEL_AXIS in mesh.axis_names
+
+        def body(slots_l, hl_l, rd_l, lab_l, ovb_l, ovr_l, t, tau):
+            hl1 = hl_l[0].reshape(spec_local.pairs_shape)
+            rd1 = rd_l[0].reshape(spec_local.pairs_shape)
+            lab = lab_l[0]
+            row_mask = (lab != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab, 1).astype(jnp.float32)
+            w = handle.weights(slots_l)
+            mg = tilemm.forward_margins(hl1, rd1, w, spec_local)
+            off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
+                   if have_model else 0)
+            if oc:
+                ovb, ovr = ovb_l[0], ovr_l[0]
+                bi = ovb.astype(jnp.int64)
+                valid = ((ovb != jnp.uint32(0xFFFFFFFF))
+                         & (bi >= off) & (bi < off + nb_local))
+                idx = jnp.where(valid, bi - off, 0).astype(jnp.int32)
+                wv = jnp.where(valid, w[idx], 0.0)
+                mg = mg.at[ovr.astype(jnp.int32)].add(wv)
+            margin = (jax.lax.psum(mg, MODEL_AXIS) if have_model else mg)
+            objv = objv_fn(margin, labels, row_mask)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            if kind == "eval":
+                mets = [objv, num_ex, acc]
+                mets = [jax.lax.psum(x, DATA_AXIS) for x in mets]
+                pos = jax.lax.psum(pos, DATA_AXIS)
+                neg = jax.lax.psum(neg, DATA_AXIS)
+                return (mets[0], mets[1], mets[2], pos, neg, margin)
+            dual = dual_fn(margin, labels, row_mask)
+            g = tilemm.backward_grad(hl1, rd1, dual, spec_local)
+            if oc:
+                dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
+                g = g.at[idx].add(dv)
+            g = jax.lax.psum(g, DATA_AXIS)
+            new = handle.push(slots_l, g, t, tau)
+            d0 = new[:, 0] - slots_l[:, 0]
+            wdelta2 = jnp.sum(d0 * d0)
+            if have_model:
+                wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
+            packed = jnp.concatenate([
+                jnp.stack([jax.lax.psum(objv, DATA_AXIS),
+                           jax.lax.psum(num_ex, DATA_AXIS),
+                           jax.lax.psum(acc, DATA_AXIS),
+                           wdelta2]),
+                jax.lax.psum(pos, DATA_AXIS),
+                jax.lax.psum(neg, DATA_AXIS)])
+            return new, packed
+
+        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
+                else P(DATA_AXIS, None, None, None))
+        in_specs = (Pm, Pblk, Pblk, P(DATA_AXIS, None),
+                    P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P())
+        if kind == "train":
+            out_specs = (Pm, P())
+        else:
+            out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
+        step = jax.jit(
+            shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False),
+            # donate slots only when the step returns them (train); the
+            # eval step has no aliasable output, so donating would leave
+            # self.slots pointing at a donated buffer
+            donate_argnums=(0,) if kind == "train" else ())
+        if not hasattr(self, "_tile_cache"):
+            self._tile_cache = {}
+        self._tile_cache[key] = step
+        return step
+
+    def tile_train_step_mesh(self, blocks: dict, info, tau: float = 0.0):
+        """Mesh tile step over ``data_axis_size`` blocks stacked on a
+        leading axis: blocks = {hl (D,T,SG,N), rd same, labels (D,R),
+        ovf_b (D,O), ovf_r (D,O)}."""
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        step = self._tile_step_mesh(info, "train")
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        self.slots, metrics = step(
+            self.slots, blocks["hl"], blocks["rd"], blocks["labels"],
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z),
+            jnp.asarray(float(self.t), jnp.float32),
+            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
+        self.t += 1
+        return metrics
+
+    def tile_eval_step_mesh(self, blocks: dict, info):
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        return self._tile_step_mesh(info, "eval")(
+            self.slots, blocks["hl"], blocks["rd"], blocks["labels"],
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z))
+
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block step over a typed block dict (crec.block2_views
         shipped to device); returns (objv, num_ex, acc, pos_hist, neg_hist,
@@ -370,22 +526,44 @@ class ShardedStore(TableCheckpoint):
     def save_model(self, path: str, rank: Optional[int] = None) -> None:
         """Write nonzero (bucket, weight) pairs as text — the reference's
         per-server ``${model_out}_${server_id}`` shards; here one file per
-        host (process)."""
+        host (process). With the table sharded ACROSS processes, each host
+        writes exactly its addressable bucket rows (global ids)."""
         from wormhole_tpu.data.stream import open_stream
         if rank is None:
             rank = jax.process_index()
-        w = np.asarray(self.handle.weights(self.slots))
-        nz = np.nonzero(w)[0]
+        if getattr(self.slots, "is_fully_addressable", True):
+            shards = [(0, np.asarray(self.slots))]
+        else:
+            parts = {}
+            for s in self.slots.addressable_shards:
+                start = s.index[0].start or 0
+                parts[start] = np.asarray(s.data)
+            shards = sorted(parts.items())
         with open_stream(f"{path}_{rank}", "w") as f:
-            for i in nz:
-                f.write(f"{i}\t{w[i]:.6g}\n")
+            for start, block in shards:
+                w = np.asarray(self.handle.weights(jnp.asarray(block)))
+                for i in np.nonzero(w)[0]:
+                    f.write(f"{start + i}\t{w[i]:.6g}\n")
 
     def load_model(self, path: str) -> None:
+        """Read back a save_model dump. ``path`` may be the bare
+        ``model_out`` prefix: all ``{path}_{rank}`` shard files are merged
+        (save_model writes per-host shards, so a bare model_out -> model_in
+        round trip works without manually appending "_0")."""
+        import glob as _glob
         from wormhole_tpu.data.stream import open_stream
-        with open_stream(path, "r") as f:
-            text = f.read()
-        if isinstance(text, bytes):
-            text = text.decode()
+        paths = [path]
+        if not os.path.exists(path):
+            shard_paths = sorted(_glob.glob(path + "_*"))
+            if not shard_paths:
+                raise FileNotFoundError(path)
+            paths = shard_paths
+        text = ""
+        for pth in paths:
+            with open_stream(pth, "r") as f:
+                t = f.read()
+            text += t.decode() if isinstance(t, bytes) else t
+            text += "\n"
         w = np.zeros(self.cfg.num_buckets, np.float32)
         for ln in text.splitlines():
             if ln.strip():
@@ -393,5 +571,6 @@ class ShardedStore(TableCheckpoint):
                 w[int(k)] = float(v)
         # handle-aware warm start: slots such that w is a fixed point of a
         # zero-gradient push (FTRL must seed z, not just slot 0)
-        self.slots = jax.device_put(self.handle.warm_start(jnp.asarray(w)),
-                                    self.slots.sharding)
+        self.slots = put_like(self.slots,
+                              np.asarray(self.handle.warm_start(
+                                  jnp.asarray(w))))

@@ -108,3 +108,121 @@ def _to_host(x):
         return np.asarray(x)
     except Exception:
         return x
+
+
+class ShardCheckpointer:
+    """Multihost checkpoint of process-SHARDED state (the async learner's
+    model-axis table when the mesh spans hosts).
+
+    The reference's async job has no server-state recovery at all (a dead
+    server loses its key range; SURVEY §5.3); here every process writes its
+    addressable block of each leaf to ``dir/rank{r}/ckpt_v{N}``, and resume
+    reassembles global arrays with
+    ``jax.make_array_from_process_local_data`` — requiring the SAME
+    process/mesh topology, which is exactly the restart-the-job recovery
+    model JAX multihost implies. Version commits are two-phase: every rank
+    writes its data file, all ranks barrier, then every rank writes its OWN
+    ``rank{r}/ckpt_v{N}.ok`` marker — so an interrupted save never yields a
+    loadable version, and ``latest_version()`` needs only THIS rank's
+    files, which keeps resume working when the checkpoint dir is NOT
+    shared across hosts (each rank sees only its own writes; the caller
+    allreduce-mins the per-rank versions to agree on the resume point)."""
+
+    def __init__(self, directory: str, keep: int = 2) -> None:
+        import jax
+        self.dir = directory
+        self.keep = keep
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+        if self.dir:
+            os.makedirs(os.path.join(self.dir, f"rank{self.rank}"),
+                        exist_ok=True)
+
+    def _rank_path(self, version: int, rank: int) -> str:
+        return os.path.join(self.dir, f"rank{rank}",
+                            f"ckpt_v{version}.msgpack")
+
+    def _marker(self, version: int) -> str:
+        return os.path.join(self.dir, f"rank{self.rank}",
+                            f"ckpt_v{version}.ok")
+
+    def save(self, version: int, state: Any) -> None:
+        import jax
+        import numpy as np
+
+        def local_block(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # dedupe replicas (e.g. data-axis copies of a model-sharded
+                # table share an index) — same rule as put_like/save_model
+                parts = {}
+                for s in x.addressable_shards:
+                    parts[s.index[0].start or 0] = np.asarray(s.data)
+                return np.concatenate([parts[k] for k in sorted(parts)])
+            return _to_host(x)
+
+        leaves = jax.tree.leaves(jax.tree.map(local_block, state))
+        data = serialization.to_bytes(
+            {str(i): leaf for i, leaf in enumerate(leaves)})
+        path = self._rank_path(version, self.rank)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        # all ranks must have committed before the version becomes valid
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_v{version}")
+        open(self._marker(version), "w").close()
+        self._gc(version)
+
+    def load(self, template: Any,
+             version: Optional[int] = None) -> Tuple[int, Any]:
+        import jax
+        ver = self.latest_version() if version is None else version
+        if ver == 0:
+            return 0, template
+        path = self._rank_path(ver, self.rank)
+        leaves, treedef = jax.tree.flatten(template)
+        with open(path, "rb") as f:
+            raw = serialization.msgpack_restore(f.read())
+
+        def restore_leaf(i, tmpl):
+            val = raw[str(i)]
+            if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
+                return jax.make_array_from_process_local_data(
+                    tmpl.sharding, val)
+            return val
+
+        state = jax.tree.unflatten(
+            treedef,
+            [restore_leaf(i, t) for i, t in enumerate(leaves)])
+        log.info("restart from version=%d (%s)", ver, path)
+        return ver, state
+
+    def latest_version(self) -> int:
+        """Newest version THIS rank has fully committed (data + marker).
+        Cross-rank agreement is the caller's job (allreduce-min), which is
+        what makes non-shared checkpoint dirs work."""
+        d = os.path.join(self.dir, f"rank{self.rank}") if self.dir else ""
+        if not d or not os.path.isdir(d):
+            return 0
+        ok = re.compile(r"^ckpt_v(\d+)\.ok$")
+        vers = [int(m.group(1)) for n in os.listdir(d)
+                if (m := ok.match(n))
+                and os.path.exists(self._rank_path(int(m.group(1)),
+                                                   self.rank))]
+        return max(vers, default=0)
+
+    def _gc(self, newest: int) -> None:
+        # each rank cleans its own dir (other ranks' dirs may not even be
+        # visible on a non-shared filesystem)
+        d = os.path.join(self.dir, f"rank{self.rank}")
+        if not os.path.isdir(d):
+            return
+        pat = re.compile(r"^ckpt_v(\d+)\.(msgpack|ok)$")
+        for n in os.listdir(d):
+            m = pat.match(n)
+            if m and int(m.group(1)) <= newest - self.keep:
+                try:
+                    os.remove(os.path.join(d, n))
+                except OSError:
+                    pass

@@ -11,11 +11,15 @@ TPU mapping (SURVEY.md §7 stage 7 — "the rabit→ICI shim's stress test"):
 
 - features are quantile-binned to uint8 on the host once (the hist
   algorithm's sketch);
-- each tree grows depth-wise: one jitted level step computes the
-  (nodes, features, bins, grad/hess) histogram as a scatter-add over rows
-  sharded on the ``data`` mesh axis — the replicated output IS the
-  histogram allreduce, XLA inserts the psum — then best-split gain via
-  cumulative bin sums, then row routing;
+- each tree grows depth-wise in three pieces: a jitted level kernel
+  (``_level_hists``) scatter-adds the (nodes, features, bins, grad/hess)
+  histograms over this host's rows (single-process: rows sharded on the
+  local ``data`` mesh axis, XLA psums the histogram); the per-level
+  cross-host histogram allreduce is an explicit host collective
+  (``allreduce_tree`` — the rabit Allreduce the reference's distributed
+  xgboost does per level); split selection (``_best_splits``) runs in
+  host numpy f64 so every process picks bit-identical splits; row
+  routing (``_route_rows``) is jitted again;
 - no data-dependent control flow: every node of a level splits in parallel
   (non-splitting nodes become leaves and their rows stop contributing via a
   row mask); shapes are static in (level, features, bins).
@@ -78,22 +82,19 @@ def _grad_hess(margin: jax.Array, labels: jax.Array, objective: str):
     raise ValueError(f"unknown objective {objective!r}")
 
 
-@partial(jax.jit, static_argnames=("num_nodes", "num_bins", "lam", "gamma",
-                                   "min_child"))
-def _grow_level(bins: jax.Array, node: jax.Array, grad: jax.Array,
-                hess: jax.Array, row_mask: jax.Array, active: jax.Array, *,
-                num_nodes: int, num_bins: int, lam: float, gamma: float,
-                min_child: float):
-    """One depth level for all its nodes at once.
+@partial(jax.jit, static_argnames=("num_nodes", "num_bins"))
+def _level_hists(bins: jax.Array, node: jax.Array, grad: jax.Array,
+                 hess: jax.Array, row_mask: jax.Array, *,
+                 num_nodes: int, num_bins: int):
+    """LOCAL (node, feature, bin) grad/hess histograms for one level.
 
     bins (n, F) uint8; node (n,) int32 LOCAL node id of each row within
     this level; row_mask (n,) 0 for rows already parked on a leaf (or data
-    padding); active (num_nodes,) bool. Returns per-node split decisions,
-    per-node leaf values, and per-row go_right bits.
+    padding). In a multi-process run each host histograms its own row
+    shard and the results are allreduced — the reference's per-level
+    gradient-histogram allreduce (xgboost/README.md:27-33, dsplit=row).
     """
     n, F = bins.shape
-
-    # histogram scatter: (2, nodes·F·bins) flat, one pass for grad and hess
     f_idx = jnp.arange(F, dtype=jnp.int32)[None, :]
     flat = (node[:, None] * (F * num_bins) + f_idx * num_bins
             + bins.astype(jnp.int32)).reshape(-1)
@@ -105,32 +106,41 @@ def _grow_level(bins: jax.Array, node: jax.Array, grad: jax.Array,
     hhist = jnp.zeros(num_nodes * F * num_bins, jnp.float32).at[flat].add(
         jnp.broadcast_to(hm, (n, F)).reshape(-1)
     ).reshape(num_nodes, F, num_bins)
+    return ghist, hhist
 
-    # gain for every (node, feature, threshold): left = bins ≤ b
-    gl = jnp.cumsum(ghist, axis=-1)
-    hl = jnp.cumsum(hhist, axis=-1)
+
+def _best_splits(ghist: np.ndarray, hhist: np.ndarray, active: np.ndarray,
+                 lam: float, gamma: float, min_child: float):
+    """Split selection from GLOBAL histograms — host numpy in f64, so every
+    process picks bit-identical splits from the allreduced hists (the
+    scheduler-side determinism the rabit BSP model relies on)."""
+    num_nodes, F, num_bins = ghist.shape
+    gl = np.cumsum(ghist.astype(np.float64), axis=-1)
+    hl = np.cumsum(hhist.astype(np.float64), axis=-1)
     gtot, htot = gl[..., -1:], hl[..., -1:]
     gr, hr = gtot - gl, htot - hl
     gain = (gl * gl / (hl + lam) + gr * gr / (hr + lam)
             - gtot * gtot / (htot + lam))
     ok = (hl >= min_child) & (hr >= min_child)
-    gain = jnp.where(ok, gain, -jnp.inf)
-    gain = gain.at[..., -1].set(-jnp.inf)  # "everything left" isn't a split
-
+    gain = np.where(ok, gain, -np.inf)
+    gain[..., -1] = -np.inf            # "everything left" isn't a split
     flat_gain = gain.reshape(num_nodes, F * num_bins)
-    best = jnp.argmax(flat_gain, axis=-1)
-    best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
-    best_f = (best // num_bins).astype(jnp.int32)
-    best_b = (best % num_bins).astype(jnp.int32)
+    best = np.argmax(flat_gain, axis=-1)
+    best_gain = np.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+    best_f = (best // num_bins).astype(np.int32)
+    best_b = (best % num_bins).astype(np.int32)
+    do_split = active & (best_gain > gamma) & np.isfinite(best_gain)
+    leaf_w = (-gtot[:, 0, 0] / (htot[:, 0, 0] + lam)).astype(np.float32)
+    return do_split, best_f, best_b, leaf_w
 
-    do_split = active & (best_gain > gamma) & jnp.isfinite(best_gain)
-    leaf_w = -gtot[:, 0, 0] / (htot[:, 0, 0] + lam)
 
-    # per-row routing bit from the row's node's chosen split
+@jax.jit
+def _route_rows(bins: jax.Array, node: jax.Array, best_f: jax.Array,
+                best_b: jax.Array) -> jax.Array:
+    """Per-row go-right bit from the row's node's chosen split."""
     row_f = best_f[node]
     row_bin = jnp.take_along_axis(bins, row_f[:, None], 1)[:, 0]
-    go_right = (row_bin.astype(jnp.int32) > best_b[node]).astype(jnp.int32)
-    return do_split, best_f, best_b, leaf_w, go_right
+    return (row_bin.astype(jnp.int32) > best_b[node]).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("depth",))
@@ -195,12 +205,35 @@ class GBDT:
                                         / (1 - cfg.base_score)))
         self.history: List[float] = []  # train metric per round
 
+    def _row_shards(self) -> int:
+        """How many ways the local row arrays are sharded (and therefore
+        the padding multiple fit() must honor)."""
+        if jax.process_count() == 1:
+            return (self.rt.data_axis_size
+                    if DATA_AXIS in self.rt.mesh.axis_names else 1)
+        return len(jax.local_devices())
+
     def _shard_rows(self, arr):
-        if DATA_AXIS in self.rt.mesh.axis_names and self.rt.data_axis_size > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            return jax.device_put(arr,
-                                  NamedSharding(self.rt.mesh, P(DATA_AXIS)))
-        return jax.device_put(arr)
+        """Single-process: rows sharded over the mesh data axis. Multi-
+        process: rows stay HOST-LOCAL (each process holds its own
+        dsplit=row shard and only histograms cross hosts — a host-local
+        device_put onto a global mesh sharding would be illegal: non-
+        addressable target shards), but still spread over this host's
+        local devices so every local chip histograms a slice."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        if jax.process_count() == 1:
+            if DATA_AXIS in self.rt.mesh.axis_names \
+                    and self.rt.data_axis_size > 1:
+                return jax.device_put(
+                    arr, NamedSharding(self.rt.mesh, P(DATA_AXIS)))
+            return jax.device_put(arr)
+        local = jax.local_devices()
+        if len(local) == 1:
+            return jax.device_put(arr, local[0])
+        lmesh = getattr(self, "_local_mesh", None)
+        if lmesh is None:
+            lmesh = self._local_mesh = Mesh(np.asarray(local), (DATA_AXIS,))
+        return jax.device_put(arr, NamedSharding(lmesh, P(DATA_AXIS)))
 
     # -- one tree -----------------------------------------------------------
 
@@ -214,6 +247,7 @@ class GBDT:
         is_leaf = np.zeros(nnodes, bool)
         weight = np.zeros(nnodes, np.float32)
 
+        from wormhole_tpu.parallel.collectives import allreduce_tree
         n = bins.shape[0]
         node = jnp.zeros(n, jnp.int32)      # local id within current level
         row_mask = jnp.asarray(data_mask)   # 0 once parked on a leaf
@@ -221,24 +255,30 @@ class GBDT:
         for depth in range(d + 1):
             level_nodes = 2 ** depth
             offset = level_nodes - 1        # first global id of this level
-            do_split_d, bf_d, bb_d, leaf_w_d, go_right = _grow_level(
-                bins, node, grad, hess, row_mask, jnp.asarray(active),
-                num_nodes=level_nodes, num_bins=cfg.num_bins,
-                lam=cfg.reg_lambda, gamma=cfg.gamma,
+            ghist, hhist = _level_hists(
+                bins, node, grad, hess, row_mask,
+                num_nodes=level_nodes, num_bins=cfg.num_bins)
+            # the per-level histogram allreduce (rabit → host collective);
+            # identity on a single process
+            ghist, hhist = allreduce_tree(
+                (np.asarray(ghist), np.asarray(hhist)), self.rt.mesh)
+            do_split, bf, bb, leaf_w = _best_splits(
+                ghist, hhist, active, lam=cfg.reg_lambda, gamma=cfg.gamma,
                 min_child=cfg.min_child_weight)
-            do_split = np.array(do_split_d)  # writable copy
             if depth == d:                  # bottom level: all leaves
                 do_split[:] = False
             ids = offset + np.arange(level_nodes)
             newly_leaf = active & ~do_split
             is_leaf[ids[newly_leaf]] = True
-            weight[ids[newly_leaf]] = np.asarray(leaf_w_d)[newly_leaf]
-            feature[ids[do_split]] = np.asarray(bf_d)[do_split]
-            split_bin[ids[do_split]] = np.asarray(bb_d)[do_split]
+            weight[ids[newly_leaf]] = leaf_w[newly_leaf]
+            feature[ids[do_split]] = bf[do_split]
+            split_bin[ids[do_split]] = bb[do_split]
             if not do_split.any():
                 break
             # rows on split nodes descend (local child id = 2j + go);
             # rows on fresh leaves stop contributing
+            go_right = _route_rows(bins, node, jnp.asarray(bf),
+                                   jnp.asarray(bb))
             on_split = jnp.asarray(do_split)[node]
             node = jnp.where(on_split, 2 * node + go_right, 0)
             row_mask = row_mask * on_split
@@ -254,6 +294,29 @@ class GBDT:
 
     # -- boosting -----------------------------------------------------------
 
+    def _global_cuts(self, x: np.ndarray) -> np.ndarray:
+        """Quantile cuts every process agrees on: each host contributes a
+        (capped) sample of its rows, samples are allgathered and the
+        percentiles taken over the merged pool — exact when the data fits
+        the cap, an ordinary merged-sketch approximation beyond it (the
+        xgboost distributed sketch plays the same game)."""
+        cfg = self.cfg
+        if jax.process_count() == 1:
+            _, cuts = quantile_bins(x, cfg.num_bins)
+            return cuts
+        from jax.experimental import multihost_utils
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cap = 1 << 16
+        take = np.asarray(x[:cap], np.float32)
+        n_max = int(allreduce_tree(np.int64(len(take)), self.rt.mesh,
+                                   "max"))
+        buf = np.full((n_max, x.shape[1]), np.nan, np.float32)
+        buf[:len(take)] = take
+        merged = np.asarray(multihost_utils.process_allgather(buf)
+                            ).reshape(-1, x.shape[1])
+        qs = np.linspace(0, 100, cfg.num_bins + 1)[1:-1]
+        return np.nanpercentile(merged, qs, axis=0).T.astype(np.float32)
+
     def fit(self, x: np.ndarray, y: np.ndarray,
             sample_mask: Optional[np.ndarray] = None) -> "GBDT":
         """Train on a dense (n, F) matrix (rows = this host's dsplit=row
@@ -264,11 +327,14 @@ class GBDT:
             # resumed: bin with the CHECKPOINTED cuts — fresh quantiles of
             # this shard would disagree with the bins the saved trees split on
             bins_np = apply_bins(x, self.cuts)
-        else:
+        elif jax.process_count() == 1:
             bins_np, self.cuts = quantile_bins(x, cfg.num_bins)
-        # pad rows to a multiple of the data axis (padded rows carry mask 0
+        else:
+            self.cuts = self._global_cuts(x)
+            bins_np = apply_bins(x, self.cuts)
+        # pad rows to the local shard multiple (padded rows carry mask 0
         # so they contribute nothing to histograms or metrics)
-        ds = max(self.rt.data_axis_size, 1)
+        ds = max(self._row_shards(), 1)
         n = bins_np.shape[0]
         n_pad = -(-n // ds) * ds
         mask_np = (np.ones(n, np.float32) if sample_mask is None
@@ -300,10 +366,17 @@ class GBDT:
                 tree.feature[None], tree.split_bin[None],
                 tree.is_leaf[None], tree.weight[None], bins,
                 depth=cfg.max_depth + 1)
-            metric = float(logloss(labels, margin, mask)) \
-                if cfg.objective == "binary:logistic" else \
-                float(jnp.sum((margin - labels) ** 2 * mask)
-                      / jnp.maximum(jnp.sum(mask), 1))
+            # weighted SUMS locally, reduce across hosts, then divide —
+            # the merged metric every process prints identically
+            den_l = float(jnp.sum(mask))
+            if cfg.objective == "binary:logistic":
+                num_l = float(logloss(labels, margin, mask)) * den_l
+            else:
+                num_l = float(jnp.sum((margin - labels) ** 2 * mask))
+            from wormhole_tpu.parallel.collectives import allreduce_tree
+            num, den = allreduce_tree(
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+            metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
             log.info("round %d: train %s=%.6f", r,
                      "logloss" if cfg.objective == "binary:logistic"
@@ -332,12 +405,31 @@ class GBDT:
             jnp.asarray(self.predict_margin(x))))
 
     def evaluate(self, x: np.ndarray, y: np.ndarray) -> dict:
+        """Metrics over (x, y); in a multi-process run x is this host's
+        shard and the returned metrics are MERGED across hosts (summed
+        logloss/accuracy, histogram-pooled AUC — dist_monitor semantics)."""
         m = jnp.asarray(self.predict_margin(x))
         labels = jnp.asarray(y, jnp.float32)
         mask = jnp.ones_like(labels)
-        return {"auc": float(auc(labels, m, mask)),
-                "accuracy": float(accuracy(labels, m, mask)),
-                "logloss": float(logloss(labels, m, mask))}
+        if jax.process_count() == 1:
+            return {"auc": float(auc(labels, m, mask)),
+                    "accuracy": float(accuracy(labels, m, mask)),
+                    "logloss": float(logloss(labels, m, mask))}
+        from wormhole_tpu.ops.metrics import auc_from_hist, margin_hist
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        n_l = float(jnp.sum(mask))
+        sums = {"n": n_l,
+                "acc": float(accuracy(labels, m, mask)) * n_l,
+                "ll": float(logloss(labels, m, mask)) * n_l}
+        pos, neg = margin_hist(labels, m, mask)
+        red = allreduce_tree(
+            {**{k: np.float64(v) for k, v in sums.items()},
+             "pos": np.asarray(pos), "neg": np.asarray(neg)},
+            self.rt.mesh)
+        n = max(float(red["n"]), 1.0)
+        return {"auc": float(auc_from_hist(red["pos"], red["neg"])),
+                "accuracy": float(red["acc"]) / n,
+                "logloss": float(red["ll"]) / n}
 
     # -- checkpoint / model IO ----------------------------------------------
 
@@ -353,12 +445,18 @@ class GBDT:
         if not self.cfg.checkpoint_dir:
             return 0
         ver = self.ckpt.latest_version()
+        if jax.process_count() > 1:
+            # ranks must agree on the resume point (and hence on whether
+            # the _global_cuts collectives run) even when the checkpoint
+            # dir is not shared: the slowest view wins
+            from wormhole_tpu.parallel.collectives import allreduce_tree
+            ver = int(allreduce_tree(np.int64(ver), self.rt.mesh, "min"))
         if not ver:
             return 0
         template = {"trees": [self._ckpt_template() for _ in range(ver)],
                     "cuts": np.zeros((num_features, self.cfg.num_bins - 1),
                                      np.float32)}
-        _, state = self.ckpt.load(template)
+        _, state = self.ckpt.load(template, version=ver)
         self.trees = list(state["trees"])
         self.cuts = np.asarray(state["cuts"])
         log.info("resumed from round %d", ver)
@@ -448,12 +546,23 @@ def main(argv=None) -> int:
     if not cli.data:
         raise SystemExit("need data=<uri>")
     rt = MeshRuntime.create(cli.mesh_shape)
-    x, y = load_dense(cli.data, cli.data_format, cli.num_features)
+    # each process reads its dsplit=row shard (RowBlockIter rank/world)
+    part, nparts = rt.local_part()
+    x, y = load_dense(cli.data, cli.data_format, cli.num_features,
+                      part, nparts)
+    if rt.world > 1 and not cli.num_features:
+        # hosts must agree on the column count (the reference's
+        # rabit::Allreduce<op::Max> of num-cols, lbfgs-linear/linear.cc:110)
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max"))
+        if x.shape[1] < F:
+            x = np.pad(x, ((0, 0), (0, F - x.shape[1])))
     model = GBDT(cli, rt)
     model.fit(x, y)
     log.info("train metrics: %s", model.evaluate(x, y))
     if cli.val_data:
-        xv, yv = load_dense(cli.val_data, cli.data_format, x.shape[1])
+        xv, yv = load_dense(cli.val_data, cli.data_format, x.shape[1],
+                            part, nparts)
         log.info("val metrics: %s", model.evaluate(xv, yv))
     if cli.model_dump:
         model.dump_model(cli.model_dump)

@@ -43,11 +43,14 @@ class Checkpointer:
 
     # --- rabit surface ---
 
-    def load(self, template: Any) -> Tuple[int, Any]:
-        """LoadCheckPoint: returns (version, state); (0, template) if fresh."""
+    def load(self, template: Any,
+             version: Optional[int] = None) -> Tuple[int, Any]:
+        """LoadCheckPoint: returns (version, state); (0, template) if fresh.
+        ``version`` pins an explicit resume point (multi-process callers
+        agree on one across ranks first)."""
         if not self.dir:
             return 0, template
-        ver = self.latest_version()
+        ver = self.latest_version() if version is None else version
         if ver == 0:
             return 0, template
         path = self._path(ver)

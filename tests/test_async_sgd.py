@@ -300,3 +300,52 @@ def test_ftrl_warm_start_fixed_point():
                       jnp.float32(0.0))
     np.testing.assert_allclose(np.asarray(handle.weights(new)), w,
                                atol=1e-6)
+
+
+def test_param_dtype_bf16_learns(rng, tmp_path):
+    """param_dtype=bfloat16 halves table storage; compute stays f32, so
+    the learner still converges (within looser accumulator precision)."""
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, rng, n=500, f=60)
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    import jax.numpy as jnp
+    cfg = Config(train_data=path, minibatch=100, max_data_pass=3,
+                 num_buckets=NB, lr_eta=0.3, fixed_bytes=0, disp_itv=1e9,
+                 param_dtype="bfloat16")
+    app = AsyncSGD(cfg, MeshRuntime.create())
+    prog = app.run()
+    assert app.store.slots.dtype == jnp.bfloat16
+    auc = prog.auc / max(prog.count, 1)
+    assert auc > 0.7, f"bf16 train AUC {auc:.3f}"
+
+
+def test_epsilon_early_stop(rng, tmp_path):
+    """Config.epsilon: a pass that barely improves objv ends training
+    before max_data_pass."""
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, rng, n=200, f=40)
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    cfg = Config(train_data=path, minibatch=100, max_data_pass=50,
+                 num_buckets=NB, lr_eta=0.05, fixed_bytes=0, disp_itv=1e9,
+                 epsilon=0.3)  # huge tolerance: stop as soon as possible
+    app = AsyncSGD(cfg, MeshRuntime.create())
+    prog = app.run()
+    # pass 0 establishes the baseline, pass 1 triggers the stop
+    assert prog.num_ex < 50 * 200, prog.num_ex
+
+
+def test_checkpoint_every_skips_passes(rng, tmp_path):
+    """checkpoint_every=2 writes versions 2 and 4 only."""
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, rng, n=100, f=40)
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.checkpoint import Checkpointer
+    ck = str(tmp_path / "ckpt")
+    cfg = Config(train_data=path, minibatch=50, max_data_pass=4,
+                 num_buckets=NB, disp_itv=1e9, checkpoint_dir=ck,
+                 checkpoint_every=2)
+    AsyncSGD(cfg, MeshRuntime.create()).run()
+    import os
+    names = sorted(os.listdir(ck))
+    assert any("v4" in n for n in names), names
+    assert not any("v3" in n for n in names), names

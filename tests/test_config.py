@@ -12,7 +12,7 @@ def test_defaults_match_reference_schema():
     assert c.minibatch == 1000
     assert c.max_data_pass == 10
     assert c.max_delay == 0
-    assert c.key_cache and c.msg_compression and c.fixed_bytes == 1
+    assert c.fixed_bytes == 1 and c.msg_compression is False
 
 
 def test_cli_overrides(tmp_path):
@@ -33,8 +33,8 @@ def test_cli_overrides(tmp_path):
 
 
 def test_colon_style_and_bool():
-    c = load_config(None, ["key_cache=false", "loss:square_hinge"])
-    assert c.key_cache is False
+    c = load_config(None, ["msg_compression=true", "loss:square_hinge"])
+    assert c.msg_compression is True
     assert c.loss is Loss.SQUARE_HINGE
 
 

@@ -44,6 +44,10 @@ def test_mp_collectives():
         root = broadcast_tree(
             np.asarray(42.0 if rt.rank == 0 else -1.0), rt.mesh)
         assert float(root) == 42.0, root
+        # COMPRESSING filter analogue: zlib'd payloads reduce identically
+        big = np.full(4096, float(rt.rank + 1), np.float64)
+        z = allreduce_tree(big, rt.mesh, "sum", compress=True)
+        assert np.allclose(np.asarray(z), 3.0), z
         print(f"OK rank {rt.rank}")
     """)
     assert out.count("OK rank") == 2

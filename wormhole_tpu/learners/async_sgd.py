@@ -39,7 +39,8 @@ from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
 from wormhole_tpu.utils.config import Config
 from wormhole_tpu.utils.logging import get_logger
-from wormhole_tpu.utils.progress import Progress
+from wormhole_tpu.utils.progress import (ModelMonitor, Progress,
+                                         TimeReporter, WorkerMonitor)
 from wormhole_tpu.utils.timer import Timer
 
 log = get_logger("async_sgd")
@@ -73,7 +74,8 @@ class AsyncSGD:
                 StoreConfig(num_buckets=cfg.num_buckets,
                             loss=cfg.loss.value,
                             fixed_bytes=cfg.fixed_bytes,
-                            lr_theta=cfg.lr_theta),
+                            lr_theta=cfg.lr_theta,
+                            param_dtype=cfg.param_dtype),
                 handle, self.rt)
         elif (buckets := getattr(getattr(store, "cfg", None),
                                  "num_buckets", None)) is not None \
@@ -91,12 +93,16 @@ class AsyncSGD:
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()
         self.start_time = time.time()
-        self._last_disp = 0.0
         self._prev_num_ex = 0
         self.progress = Progress()
         self._max_nnz = cfg.max_nnz
         self._warned_trunc = False
-        self._last_nnz = 0  # model nnz sampled at pass boundaries only
+        # the reference monitor chain (monitor.h + dist_monitor.h): workers
+        # accumulate into a WorkerMonitor, a rate-limited TimeReporter
+        # drives the scheduler display row, a ModelMonitor tracks nnz(w)
+        # and weight-delta norms at pass boundaries
+        self.model_monitor = ModelMonitor()
+        self.reporter = TimeReporter(self._emit_row, interval=cfg.disp_itv)
         self.timer = Timer()  # pipeline stage profile (SURVEY §5.1)
         from wormhole_tpu.parallel.checkpoint import Checkpointer
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
@@ -151,18 +157,15 @@ class AsyncSGD:
         cfg = self.cfg
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         inflight: deque = deque()
-        local = Progress()
+        mon = WorkerMonitor()          # per-part metric accumulation
+        local = mon.prog
 
         def harvest(item) -> None:
             metrics, labels, row_mask = item
             metrics = jax.block_until_ready(metrics)
             objv, num_ex, a, acc = (float(np.asarray(m))
                                     for m in metrics[:4])
-            local.objv += objv
-            local.num_ex += int(num_ex)
-            local.count += 1
-            local.auc += a
-            local.acc += acc
+            mon.update(int(num_ex), objv, a, acc)
             if kind == TRAIN and len(metrics) > 4:
                 local.wdelta2 += float(np.asarray(metrics[4]))
             if pooled is not None and len(metrics) > 4:
@@ -316,8 +319,7 @@ class AsyncSGD:
             m = item[0]
             jax.block_until_ready(m[0] if isinstance(m, tuple) else m)
             pending.append(item)
-            if kind == TRAIN \
-                    and time.time() - self._last_disp >= self.cfg.disp_itv:
+            if kind == TRAIN and self.reporter.due():
                 drain_pending()
 
         def _labels_of(host) -> np.ndarray:
@@ -449,7 +451,7 @@ class AsyncSGD:
                 if kind == TRAIN:
                     pending.append(
                         self.store.tile_train_step_mesh(blocks, info))
-                    if time.time() - self._last_disp >= self.cfg.disp_itv:
+                    if self.reporter.due():
                         with self.timer.scope(pfx + "wait"):
                             drain_pending()
                 else:
@@ -518,26 +520,46 @@ class AsyncSGD:
             # a checkpoint resume supersedes it
             self.store.load_model(cfg.model_in)
             log.info("warm start from %s", cfg.model_in)
+        prev_objv_ex = None
+        last_saved = start_pass
+        completed = start_pass
         for data_pass in range(start_pass, cfg.max_data_pass):
             self.pool.clear()
             self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
+            wd_before = self.progress.wdelta2
+            pass_prog = Progress()
             while True:
                 wl = self.pool.get(worker)
                 if wl is None:
                     break
                 prog = self.process(wl.file, wl.part, wl.nparts, wl.kind)
                 self.progress.merge(prog)
+                pass_prog.merge(prog)
                 self.pool.finish(wl.id)
                 self._check_divergence(prog)
-            self._last_nnz = self.store.nnz_weight()
-            if cfg.checkpoint_dir and self._ckpt_ok():
-                self.ckpt.save(data_pass + 1, self.store.state_pytree())
+            nnz = self.store.nnz_weight()
+            self.model_monitor.update_delta(
+                nnz, self.model_monitor.prog.nnz_w,
+                self.progress.wdelta2 - wd_before)
+            self.model_monitor.set_nnz(nnz)
+            completed = data_pass + 1
+            if cfg.checkpoint_dir and self._ckpt_ok() \
+                    and completed % max(cfg.checkpoint_every, 1) == 0:
+                self.ckpt.save(completed, self.store.state_pytree())
+                last_saved = completed
             if cfg.val_data:
                 vp, pass_auc = self._run_eval(cfg.val_data)
                 n = max(vp.num_ex, 1)
                 log.info("pass %d validation: objv=%.6f auc=%.6f acc=%.6f",
                          data_pass, vp.objv / n, pass_auc,
                          vp.acc / max(vp.count, 1))
+            if self._converged(data_pass, pass_prog, prev_objv_ex):
+                break
+            prev_objv_ex = pass_prog.objv / max(pass_prog.num_ex, 1)
+        if cfg.checkpoint_dir and self._ckpt_ok() and last_saved < completed:
+            # the final pass must never be lost to checkpoint_every
+            # misalignment or an epsilon early stop
+            self.ckpt.save(completed, self.store.state_pytree())
         if cfg.test_data:
             self.predict(cfg.test_data, cfg.pred_out)
         if cfg.model_out:
@@ -777,13 +799,19 @@ class AsyncSGD:
             log.info("warm start from %s", cfg.model_in)
         if self.rt.rank == 0:
             print(Progress.HEADER)
+        prev_objv_ex = None
+        last_saved = start_pass
+        completed = start_pass
         for data_pass in range(start_pass, cfg.max_data_pass):
             prog = self._multihost_pass(cfg.train_data, TRAIN)
             self.progress.merge(prog)
             self._check_divergence(prog)
-            if ckpt is not None:
-                self.ckpt_version = data_pass + 1
-                ckpt.save(data_pass + 1, self.store.state_pytree())
+            completed = data_pass + 1
+            if ckpt is not None \
+                    and completed % max(cfg.checkpoint_every, 1) == 0:
+                self.ckpt_version = completed
+                ckpt.save(completed, self.store.state_pytree())
+                last_saved = completed
             if cfg.val_data:
                 pooled: list = []
                 vp = self._multihost_pass(cfg.val_data, VAL, pooled)
@@ -792,6 +820,16 @@ class AsyncSGD:
                 log.info("pass %d validation: objv=%.6f auc=%.6f "
                          "acc=%.6f", data_pass, vp.objv / n, pass_auc,
                          vp.acc / max(vp.count, 1))
+            # prog is GLOBAL (identical on all ranks), so every rank
+            # takes the early-stop branch in the same pass
+            if self._converged(data_pass, prog, prev_objv_ex):
+                break
+            prev_objv_ex = prog.objv / max(prog.num_ex, 1)
+        if ckpt is not None and last_saved < completed:
+            # the final pass must never be lost to checkpoint_every
+            # misalignment or an epsilon early stop
+            self.ckpt_version = completed
+            ckpt.save(completed, self.store.state_pytree())
         if cfg.test_data:
             from wormhole_tpu.sched.workload_pool import TEST
             pooled = []
@@ -818,8 +856,11 @@ class AsyncSGD:
                  * (bins - 1)).astype(np.int64)
             np.add.at(pos, b, (labels > 0.5) * weights)
             np.add.at(neg, b, (labels <= 0.5) * weights)
-        pos = np.asarray(allreduce_tree(pos, self.rt.mesh, "sum"))
-        neg = np.asarray(allreduce_tree(neg, self.rt.mesh, "sum"))
+        z = self.cfg.msg_compression
+        pos = np.asarray(allreduce_tree(pos, self.rt.mesh, "sum",
+                                        compress=z))
+        neg = np.asarray(allreduce_tree(neg, self.rt.mesh, "sum",
+                                        compress=z))
         return auc_from_hist(pos, neg)
 
     def _write_preds(self, pooled: list, out_path: str) -> None:
@@ -903,17 +944,36 @@ class AsyncSGD:
     # -- observability ------------------------------------------------------
 
     def _display(self, local: Progress) -> None:
-        now = time.time()
-        if now - self._last_disp < self.cfg.disp_itv or self.rt.rank != 0:
+        if self.rt.rank != 0:
             return
-        self._last_disp = now
+        self.reporter.report(local)
+
+    def _emit_row(self, local: Progress) -> None:
         snap = Progress(self.progress.fvec + local.fvec,
                         self.progress.ivec + local.ivec)
-        # nnz from the last pass boundary: a live nnz_weight() would force a
-        # full-model sync and drain the dispatch pipeline every disp_itv
-        snap.nnz_w = self._last_nnz
-        print(snap.print_row(now - self.start_time, self._prev_num_ex))
+        # nnz from the last pass boundary (ModelMonitor): a live
+        # nnz_weight() would force a full-model sync and drain the
+        # dispatch pipeline every disp_itv
+        snap.nnz_w = self.model_monitor.prog.nnz_w
+        print(snap.print_row(time.time() - self.start_time,
+                             self._prev_num_ex))
         self._prev_num_ex = snap.num_ex
+
+    def _converged(self, data_pass: int, pass_prog: Progress,
+                   prev_objv_ex) -> bool:
+        """Early stop (Config.epsilon, config.proto convergence tolerance):
+        a pass that improves per-example objv by less than epsilon
+        (relatively) ends training."""
+        eps = self.cfg.epsilon
+        if not eps or prev_objv_ex is None or pass_prog.num_ex == 0:
+            return False
+        cur = pass_prog.objv / max(pass_prog.num_ex, 1)
+        rel = (prev_objv_ex - cur) / max(abs(prev_objv_ex), 1e-12)
+        if rel < eps:
+            log.info("converged at pass %d: relative objv improvement "
+                     "%.2e < epsilon %.2e", data_pass, rel, eps)
+            return True
+        return False
 
     def _check_divergence(self, prog: Progress) -> None:
         """Kill switch on the *freshest* workload part (cumulative averages

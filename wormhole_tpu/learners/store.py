@@ -115,6 +115,9 @@ class StoreConfig:
     loss: str = "logit"
     fixed_bytes: int = 0      # 0 = exact; 1 = int8-style quantized grads
     lr_theta: float = 1.0     # staleness weight for DT handles
+    param_dtype: str = "float32"  # slots storage dtype; "bfloat16" halves
+                                  # table HBM at accumulator-precision cost
+                                  # (compute always runs in f32)
 
 
 class TableCheckpoint:
@@ -143,8 +146,12 @@ class ShardedStore(TableCheckpoint):
         self.handle = handle
         self.rt = runtime
         self.objv_fn, self.dual_fn = create_loss(cfg.loss)
-        self.slots = shard_param_table(handle.init(cfg.num_buckets),
-                                       runtime)
+        self.dtype = jnp.dtype(cfg.param_dtype)
+        if self.dtype not in (jnp.float32, jnp.bfloat16):
+            raise ValueError(f"param_dtype {cfg.param_dtype!r}: want "
+                             "float32 or bfloat16")
+        self.slots = shard_param_table(
+            handle.init(cfg.num_buckets).astype(self.dtype), runtime)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self.t = 1  # global update counter (SGD eta schedule)
@@ -157,7 +164,8 @@ class ShardedStore(TableCheckpoint):
 
         @partial(jax.jit, donate_argnums=(0,))
         def step(slots, batch: SparseBatch, t, tau):
-            rows = slots[batch.uniq_keys]                  # pull (gather)
+            # pull (gather); compute in f32 regardless of storage dtype
+            rows = slots[batch.uniq_keys].astype(jnp.float32)
             w = handle.weights(rows)
             margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
             objv = objv_fn(margin, batch.labels, batch.row_mask)
@@ -169,7 +177,8 @@ class ShardedStore(TableCheckpoint):
                 grad = quantize_dequantize(grad, 8 * fixed_bytes)
             new_rows = handle.push(rows, grad, t, tau)
             delta = (new_rows - rows) * batch.key_mask[:, None]
-            slots = slots.at[batch.uniq_keys].add(delta)   # push (scatter)
+            slots = slots.at[batch.uniq_keys].add(          # push (scatter)
+                delta.astype(slots.dtype))
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)
             acc = accuracy(batch.labels, margin, batch.row_mask)
@@ -183,7 +192,7 @@ class ShardedStore(TableCheckpoint):
 
         @jax.jit
         def ev(slots, batch: SparseBatch):
-            w = handle.weights(slots[batch.uniq_keys])
+            w = handle.weights(slots[batch.uniq_keys].astype(jnp.float32))
             margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             num_ex = jnp.sum(batch.row_mask)
@@ -226,7 +235,7 @@ class ShardedStore(TableCheckpoint):
             lab_u8 = packed[nk:nk + R]
             row_mask = (lab_u8 != jnp.uint8(255)).astype(jnp.float32)
             labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
-            w = handle.weights(slots)
+            w = handle.weights(slots.astype(jnp.float32))
             vf = valid.astype(jnp.float32).reshape(R, N)
             margin = jnp.sum(w[b.reshape(R, N)] * vf, axis=1)
             return b, vf, labels, row_mask, margin
@@ -242,12 +251,14 @@ class ShardedStore(TableCheckpoint):
                 dual = dual_fn(margin, labels, row_mask)
                 contrib = (dual[:, None] * vf).reshape(-1)
                 grad = jnp.zeros((nb,), jnp.float32).at[b].add(contrib)
-                new = handle.push(slots, grad, t, tau)
+                s32 = slots.astype(jnp.float32)
+                new = handle.push(s32, grad, t, tau)
                 num_ex = jnp.sum(row_mask)
                 a = auc(labels, margin, row_mask)
                 acc = accuracy(labels, margin, row_mask)
-                d0 = new[:, 0] - slots[:, 0]
-                return new, (objv, num_ex, a, acc, jnp.sum(d0 * d0))
+                d0 = new[:, 0] - s32[:, 0]
+                return (new.astype(slots.dtype),
+                        (objv, num_ex, a, acc, jnp.sum(d0 * d0)))
         else:
             @jax.jit
             def step(slots, packed):
@@ -320,18 +331,19 @@ class ShardedStore(TableCheckpoint):
             @partial(jax.jit, donate_argnums=(0,))
             def step(slots, block, t, tau):
                 hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
-                w = handle.weights(slots)
+                s32 = slots.astype(jnp.float32)
+                w = handle.weights(s32)
                 margin = tilemm.forward_margins(hl, rd, w, spec,
                                                 ovf_b, ovf_r)
                 objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
                 grad = tilemm.backward_grad(hl, rd, dual, spec,
                                             ovf_b, ovf_r)
-                new = handle.push(slots, grad, t, tau)
+                new = handle.push(s32, grad, t, tau)
                 num_ex = jnp.sum(row_mask)
                 acc = accuracy(labels, margin, row_mask)
                 pos, neg = margin_hist(labels, margin, row_mask)
-                d0 = new[:, 0] - slots[:, 0]
+                d0 = new[:, 0] - s32[:, 0]
                 # ONE packed metrics buffer per step: the harvest loop
                 # stacks pending blocks' metrics and fetches a single
                 # device buffer — per-leaf fetches are one host round
@@ -339,12 +351,12 @@ class ShardedStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new, packed
+                return new.astype(slots.dtype), packed
         else:
             @jax.jit
             def step(slots, block):
                 hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
-                w = handle.weights(slots)
+                w = handle.weights(slots.astype(jnp.float32))
                 margin = tilemm.forward_margins(hl, rd, w, spec,
                                                 ovf_b, ovf_r)
                 objv = objv_fn(margin, labels, row_mask)
@@ -399,7 +411,8 @@ class ShardedStore(TableCheckpoint):
             lab = lab_l[0]
             row_mask = (lab != jnp.uint8(255)).astype(jnp.float32)
             labels = jnp.minimum(lab, 1).astype(jnp.float32)
-            w = handle.weights(slots_l)
+            s32 = slots_l.astype(jnp.float32)
+            w = handle.weights(s32)
             mg = tilemm.forward_margins(hl1, rd1, w, spec_local)
             off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
                    if have_model else 0)
@@ -428,8 +441,8 @@ class ShardedStore(TableCheckpoint):
                 dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
                 g = g.at[idx].add(dv)
             g = jax.lax.psum(g, DATA_AXIS)
-            new = handle.push(slots_l, g, t, tau)
-            d0 = new[:, 0] - slots_l[:, 0]
+            new = handle.push(s32, g, t, tau)
+            d0 = new[:, 0] - s32[:, 0]
             wdelta2 = jnp.sum(d0 * d0)
             if have_model:
                 wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
@@ -440,7 +453,7 @@ class ShardedStore(TableCheckpoint):
                            wdelta2]),
                 jax.lax.psum(pos, DATA_AXIS),
                 jax.lax.psum(neg, DATA_AXIS)])
-            return new, packed
+            return new.astype(slots_l.dtype), packed
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
         Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
@@ -516,10 +529,12 @@ class ShardedStore(TableCheckpoint):
 
     def pull(self, keys: np.ndarray) -> np.ndarray:
         """Debug/oracle surface: weights for explicit bucket ids."""
-        return np.asarray(self.handle.weights(self.slots[jnp.asarray(keys)]))
+        return np.asarray(self.handle.weights(
+            self.slots[jnp.asarray(keys)].astype(jnp.float32)))
 
     def nnz_weight(self) -> int:
-        return int(jnp.sum(self.handle.weights(self.slots) != 0))
+        return int(jnp.sum(self.handle.weights(
+            self.slots.astype(jnp.float32)) != 0))
 
     # -- model IO (per-shard text dump, guide/conf.md:25-27) ----------------
 
@@ -541,7 +556,8 @@ class ShardedStore(TableCheckpoint):
             shards = sorted(parts.items())
         with open_stream(f"{path}_{rank}", "w") as f:
             for start, block in shards:
-                w = np.asarray(self.handle.weights(jnp.asarray(block)))
+                w = np.asarray(self.handle.weights(
+                    jnp.asarray(block).astype(jnp.float32)))
                 for i in np.nonzero(w)[0]:
                     f.write(f"{start + i}\t{w[i]:.6g}\n")
 
@@ -573,4 +589,4 @@ class ShardedStore(TableCheckpoint):
         # zero-gradient push (FTRL must seed z, not just slot 0)
         self.slots = put_like(self.slots,
                               np.asarray(self.handle.warm_start(
-                                  jnp.asarray(w))))
+                                  jnp.asarray(w)).astype(self.dtype)))

@@ -60,6 +60,8 @@ class GBDTConfig:
     objective: str = "binary:logistic"
     base_score: float = 0.5        # initial prediction (probability space)
     checkpoint_dir: str = ""
+    msg_compression: bool = False  # zlib the per-level histogram allreduce
+                                   # payloads (ps-lite COMPRESSING filter)
 
 
 @jax.tree_util.register_dataclass
@@ -261,7 +263,8 @@ class GBDT:
             # the per-level histogram allreduce (rabit → host collective);
             # identity on a single process
             ghist, hhist = allreduce_tree(
-                (np.asarray(ghist), np.asarray(hhist)), self.rt.mesh)
+                (np.asarray(ghist), np.asarray(hhist)), self.rt.mesh,
+                compress=cfg.msg_compression)
             do_split, bf, bb, leaf_w = _best_splits(
                 ghist, hhist, active, lam=cfg.reg_lambda, gamma=cfg.gamma,
                 min_child=cfg.min_child_weight)

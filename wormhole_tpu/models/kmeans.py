@@ -219,7 +219,9 @@ class KMeans:
             stats = _accumulate_jit(stats, cent_t, batch)
         sums, counts, objv, seen = stats
         if jax.process_count() > 1:
-            # cross-host Sum-allreduce (rabit::Allreduce<Sum>, kmeans.cc:249)
+            # cross-host Sum-allreduce (rabit::Allreduce<Sum> with the
+            # omp_get_centroid prepare-fn, kmeans.cc:249 — the lazy-replay
+            # half of that contract is moot here, see collectives.py)
             sums, counts, objv, seen = jax.tree.map(
                 jnp.asarray,
                 allreduce_tree(jax.tree.map(np.asarray, stats),

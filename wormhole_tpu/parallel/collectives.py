@@ -11,15 +11,19 @@ equivalents split by where they run:
   the *device* axis; across hosts JAX's multi-controller runtime makes the
   same program global (each process provides its addressable shards).
 
-Lazy-prepare Allreduce (rabit's fault-tolerance hook, kmeans.cc:249) maps to
-calling ``prepare_fn`` only when no cached reduce result exists — see
-``CachedAllreduce``.
+rabit's lazy-prepare Allreduce (``Allreduce(ptr, n, prepare_fn)``,
+kmeans.cc:249) deliberately has NO class here: its purpose is letting a
+RECOVERING node replay a cached reduce result served by surviving peers
+without recomputing. JAX multihost recovery is restart-the-whole-job from a
+checkpoint — there are no surviving peers holding a cache, so the replay
+path is structurally unreachable and "lazy prepare" collapses to just
+calling the prepare function. The fault-tolerance property itself survives
+as the versioned Checkpointer (parallel/checkpoint.py).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -44,23 +48,45 @@ def pmin_tree(tree: Any, axis: str) -> Any:
 # host-level collectives over a mesh
 # ---------------------------------------------------------------------------
 
-def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum") -> Any:
+def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
+                   compress: bool = False) -> Any:
     """Sum/max/min-allreduce a host-local pytree across the data-parallel
     world (rabit::Allreduce analogue).
 
     Each process contributes its local values; result is replicated. On a
     single process this is the identity for 'sum' *per device contribution*
-    semantics: the caller holds one logical copy, so no scaling happens."""
+    semantics: the caller holds one logical copy, so no scaling happens.
+
+    ``compress`` zlib-compresses each leaf's payload for the DCN hop (the
+    ps-lite COMPRESSING filter, async_sgd.h:144-154 / config.proto:100) —
+    worthwhile for large, compressible buffers like gradient histograms;
+    pure overhead for tiny ones."""
     if jax.process_count() == 1:
         return tree
     from jax.experimental import multihost_utils
+    npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
     fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
 
     def reduce_leaf(x):
         gathered = multihost_utils.process_allgather(jnp.asarray(x))
         return np.asarray(fn(gathered, axis=0))
 
-    return jax.tree.map(reduce_leaf, tree)
+    def reduce_leaf_z(x):
+        import zlib
+        x = np.asarray(x)
+        comp = zlib.compress(x.tobytes(), 1)
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.int64(len(comp))))
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:len(comp)] = np.frombuffer(comp, np.uint8)
+        g = np.asarray(multihost_utils.process_allgather(buf))
+        parts = [np.frombuffer(zlib.decompress(
+                     g[r, :int(lens[r])].tobytes()),
+                     x.dtype).reshape(x.shape)
+                 for r in range(g.shape[0])]
+        return npfn(np.stack(parts), axis=0)
+
+    return jax.tree.map(reduce_leaf_z if compress else reduce_leaf, tree)
 
 
 def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0) -> Any:
@@ -72,28 +98,3 @@ def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0) -> Any:
         tree, is_source=jax.process_index() == root)
 
 
-class CachedAllreduce:
-    """Lazy-prepare allreduce (rabit's ``Allreduce(ptr, n, prepare_fn)``).
-
-    ``run(prepare_fn)`` calls ``prepare_fn`` to build the local buffer and
-    reduces it; after a checkpoint restore the cached result for the same
-    sequence number is replayed without recomputation — the property rabit
-    uses for cheap recovery (kmeans.cc:177-179)."""
-
-    def __init__(self, mesh: Mesh) -> None:
-        self.mesh = mesh
-        self.seqno = 0
-        self._cache: dict = {}
-
-    def run(self, prepare_fn: Callable[[], Any], op: str = "sum") -> Any:
-        if self.seqno in self._cache:
-            out = self._cache[self.seqno]
-        else:
-            out = allreduce_tree(prepare_fn(), self.mesh, op)
-            self._cache[self.seqno] = out
-        self.seqno += 1
-        return out
-
-    def restore(self, seqno: int, cache: Optional[dict] = None) -> None:
-        self.seqno = seqno
-        self._cache = dict(cache or {})

@@ -70,7 +70,8 @@ class Config:
     minibatch: int = 1000
     max_data_pass: int = 10
     disp_itv: float = 1.0
-    epsilon: float = 1e-4
+    epsilon: float = 0.0   # early stop when a pass improves per-example
+                           # objv by less than this fraction; 0 = off
     max_objv: float = 0.0  # 0 = unset; stop if objv >= max_objv
 
     lr_eta: float = 0.1
@@ -78,14 +79,16 @@ class Config:
     lr_theta: float = 1.0
 
     # --- sync-cost reduction ---
+    # (the reference's KEY_CACHING filter has no analogue here BY DESIGN:
+    # keys never transit a network — text-path batches fold keys on the
+    # host feeding its own devices, and the crec paths fold them on
+    # device — so there is no repeated key vector to cache. COMPRESSING
+    # survives as `msg_compression` below, applied to the host-collective
+    # payloads on the DCN path; FIXING_FLOAT as `fixed_bytes`.)
     max_delay: int = 0
-    key_cache: bool = True
-    msg_compression: bool = True
+    msg_compression: bool = False  # zlib-compress host-collective payloads
     fixed_bytes: int = 1
     tail_feature_freq: int = 0
-
-    init_workload: int = 0
-    init_num_worker: int = 1
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100
@@ -107,10 +110,12 @@ class Config:
     cache_device: bool = False  # crec/crec2: keep streamed blocks resident in
                                 # HBM and replay them on later data passes
                                 # (dataset must fit device memory)
-    param_dtype: str = "float32"
+    param_dtype: str = "float32"  # slots-table storage dtype ("float32" or
+                                  # "bfloat16"; bf16 halves table HBM at
+                                  # the cost of accumulator precision)
     seed: int = 0
     checkpoint_dir: str = ""
-    checkpoint_every: int = 0   # iterations; 0 = off
+    checkpoint_every: int = 1   # save a checkpoint every N data passes
 
     def merged(self, kvs: Sequence[str]) -> "Config":
         """Return a copy with ``key=value`` tokens merged over this config."""

@@ -124,7 +124,9 @@ class ModelMonitor:
 
 
 class TimeReporter:
-    """Rate-limits metric reports (``dist_monitor.h:8-38``)."""
+    """Rate-limits metric reports (``dist_monitor.h:8-38``): call
+    ``report`` as often as you like, the wrapped ``report_fn`` fires at
+    most once per ``interval`` seconds (or on ``force``)."""
 
     def __init__(self, report_fn: Callable[[Progress], None],
                  interval: float = 1.0) -> None:
@@ -132,11 +134,20 @@ class TimeReporter:
         self._itv = interval
         self._last = 0.0
 
-    def report(self, monitor: WorkerMonitor, force: bool = False) -> None:
+    def due(self) -> bool:
+        """Whether the next ``report`` call would fire (callers use this
+        to defer expensive metric collection until it will be shown)."""
+        return time.monotonic() - self._last >= self._itv
+
+    def report(self, source, force: bool = False) -> bool:
+        """``source`` is a WorkerMonitor (fetch-and-clear delta semantics,
+        the reference reporter contract) or a bare Progress snapshot."""
         now = time.monotonic()
         if not force and now - self._last < self._itv:
-            return
-        prog = monitor.fetch_and_clear()
+            return False
+        prog = (source.fetch_and_clear()
+                if hasattr(source, "fetch_and_clear") else source)
         if not prog.empty() or force:
             self._fn(prog)
         self._last = now
+        return True

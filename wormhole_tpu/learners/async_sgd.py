@@ -518,7 +518,7 @@ class AsyncSGD:
         if not start_pass and cfg.model_in:
             # warm start (reference model_in + Broadcast, linear.cc:115-123);
             # a checkpoint resume supersedes it
-            self.store.load_model(cfg.model_in)
+            self._store_io("load", cfg.model_in)
             log.info("warm start from %s", cfg.model_in)
         prev_objv_ex = None
         last_saved = start_pass
@@ -563,7 +563,7 @@ class AsyncSGD:
         if cfg.test_data:
             self.predict(cfg.test_data, cfg.pred_out)
         if cfg.model_out:
-            self.store.save_model(cfg.model_out, self.rt.rank)
+            self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
         return self.progress
@@ -795,7 +795,7 @@ class AsyncSGD:
                 log.info("resumed at data pass %d", start_pass)
         if not start_pass and cfg.model_in:
             # every host reads the same file → identical warm-start table
-            self.store.load_model(cfg.model_in)
+            self._store_io("load", cfg.model_in)
             log.info("warm start from %s", cfg.model_in)
         if self.rt.rank == 0:
             print(Progress.HEADER)
@@ -836,7 +836,7 @@ class AsyncSGD:
             self._multihost_pass(cfg.test_data, TEST, pooled)
             self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
         if cfg.model_out:
-            self.store.save_model(cfg.model_out, self.rt.rank)
+            self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
         return self.progress
@@ -942,6 +942,23 @@ class AsyncSGD:
         self._write_preds(pooled, out_path)
 
     # -- observability ------------------------------------------------------
+
+    def _key_fold(self) -> str:
+        """Key->bucket scheme for this run's data_format (recorded in /
+        checked against saved models; the crec family folds differently
+        from the text formats — see data/hashing.py)."""
+        return ("mix32" if self.cfg.data_format in ("crec", "crec2")
+                else "splitmix64")
+
+    def _store_io(self, op: str, path: str):
+        """save/load the model with the key-fold tag — part of the store
+        protocol (ShardedStore enforces it; FM/wide&deep accept it)."""
+        if op == "save":
+            self.store.save_model(path, self.rt.rank,
+                                  key_fold=self._key_fold())
+        else:
+            self.store.load_model(path,
+                                  expect_key_fold=self._key_fold())
 
     def _display(self, local: Progress) -> None:
         if self.rt.rank != 0:

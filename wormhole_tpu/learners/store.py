@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.learners.handles import Handle
 from wormhole_tpu.ops.loss import create_loss
+from wormhole_tpu.ops.spmv import spmv_times, spmv_trans_times
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
 
@@ -167,12 +168,11 @@ class ShardedStore(TableCheckpoint):
             # pull (gather); compute in f32 regardless of storage dtype
             rows = slots[batch.uniq_keys].astype(jnp.float32)
             w = handle.weights(rows)
-            margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+            margin = spmv_times(batch.cols, batch.vals, w)
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             dual = dual_fn(margin, batch.labels, batch.row_mask)
-            contrib = batch.vals * dual[:, None]
-            grad = jnp.zeros_like(w).at[batch.cols.reshape(-1)].add(
-                contrib.reshape(-1))
+            grad = spmv_trans_times(batch.cols, batch.vals, dual,
+                                    w.shape[0])
             if fixed_bytes:
                 grad = quantize_dequantize(grad, 8 * fixed_bytes)
             new_rows = handle.push(rows, grad, t, tau)
@@ -193,7 +193,7 @@ class ShardedStore(TableCheckpoint):
         @jax.jit
         def ev(slots, batch: SparseBatch):
             w = handle.weights(slots[batch.uniq_keys].astype(jnp.float32))
-            margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+            margin = spmv_times(batch.cols, batch.vals, w)
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)
@@ -538,11 +538,18 @@ class ShardedStore(TableCheckpoint):
 
     # -- model IO (per-shard text dump, guide/conf.md:25-27) ----------------
 
-    def save_model(self, path: str, rank: Optional[int] = None) -> None:
+    def save_model(self, path: str, rank: Optional[int] = None,
+                   key_fold: str = "") -> None:
         """Write nonzero (bucket, weight) pairs as text — the reference's
         per-server ``${model_out}_${server_id}`` shards; here one file per
         host (process). With the table sharded ACROSS processes, each host
-        writes exactly its addressable bucket rows (global ids)."""
+        writes exactly its addressable bucket rows (global ids).
+
+        ``key_fold`` names the key→bucket scheme the model was trained
+        under ("splitmix64" for the text/sparse formats, "mix32" for
+        crec/crec2) — recorded as a header comment so a cross-format
+        warm start fails loudly instead of silently remapping every
+        feature (the two folds bucket the same key differently)."""
         from wormhole_tpu.data.stream import open_stream
         if rank is None:
             rank = jax.process_index()
@@ -555,17 +562,24 @@ class ShardedStore(TableCheckpoint):
                 parts[start] = np.asarray(s.data)
             shards = sorted(parts.items())
         with open_stream(f"{path}_{rank}", "w") as f:
+            if key_fold:
+                f.write(f"# key_fold={key_fold}\n")
             for start, block in shards:
                 w = np.asarray(self.handle.weights(
                     jnp.asarray(block).astype(jnp.float32)))
                 for i in np.nonzero(w)[0]:
                     f.write(f"{start + i}\t{w[i]:.6g}\n")
 
-    def load_model(self, path: str) -> None:
+    def load_model(self, path: str, expect_key_fold: str = "") -> None:
         """Read back a save_model dump. ``path`` may be the bare
         ``model_out`` prefix: all ``{path}_{rank}`` shard files are merged
         (save_model writes per-host shards, so a bare model_out -> model_in
-        round trip works without manually appending "_0")."""
+        round trip works without manually appending "_0").
+
+        ``expect_key_fold`` (when both sides name a scheme) must match the
+        recorded ``# key_fold=`` header: a model trained under one
+        data_format family silently maps every feature to different
+        buckets under the other."""
         import glob as _glob
         from wormhole_tpu.data.stream import open_stream
         paths = [path]
@@ -582,9 +596,23 @@ class ShardedStore(TableCheckpoint):
             text += "\n"
         w = np.zeros(self.cfg.num_buckets, np.float32)
         for ln in text.splitlines():
-            if ln.strip():
-                k, v = ln.split()
-                w[int(k)] = float(v)
+            ln = ln.strip()
+            if not ln:
+                continue
+            if ln.startswith("#"):
+                if "key_fold=" in ln and expect_key_fold:
+                    saved = ln.split("key_fold=")[1].split()[0]
+                    if saved != expect_key_fold:
+                        raise ValueError(
+                            f"model {path} was trained with "
+                            f"key_fold={saved} but this run folds keys "
+                            f"with {expect_key_fold} (crec formats hash "
+                            "differently from the text formats); retrain "
+                            "or convert the data, a warm start would "
+                            "remap every feature")
+                continue
+            k, v = ln.split()
+            w[int(k)] = float(v)
         # handle-aware warm start: slots such that w is a fixed point of a
         # zero-gradient push (FTRL must seed z, not just slot 0)
         self.slots = put_like(self.slots,

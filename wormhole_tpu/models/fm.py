@@ -33,6 +33,7 @@ from wormhole_tpu.learners.store import (TableCheckpoint,
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
 from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.ops.spmv import spmv_times
 from wormhole_tpu.parallel.mesh import MeshRuntime
 
 
@@ -54,7 +55,7 @@ def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
     """theta (kpad, 1+k): col 0 = w, cols 1: = v. Returns (mb,) margins."""
     w = theta[:, 0]
     v = theta[:, 1:]
-    lin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+    lin = spmv_times(batch.cols, batch.vals, w)
     vx = v[batch.cols] * batch.vals[..., None]        # (mb, nnz, k)
     s = jnp.sum(vx, axis=1)                           # (mb, k)
     s2 = jnp.sum(vx * vx, axis=1)                     # (mb, k)
@@ -151,8 +152,11 @@ class FMStore(TableCheckpoint):
     def nnz_weight(self) -> int:
         return int(jnp.sum(self.slots[:, 0] != 0))
 
-    def save_model(self, path: str, rank: Optional[int] = None) -> None:
-        """npz of (w, v) — the embedding-table export."""
+    def save_model(self, path: str, rank: Optional[int] = None,
+                   key_fold: str = "") -> None:
+        """npz of (w, v) — the embedding-table export. ``key_fold`` is
+        accepted for ShardedStore surface parity; npz carries it as an
+        attribute-free no-op (the FM table is format-agnostic here)."""
         if rank is None:
             rank = jax.process_index()
         k = self.cfg.dim
@@ -160,7 +164,7 @@ class FMStore(TableCheckpoint):
         np.savez_compressed(f"{path}_{rank}.npz", w=arr[:, 0],
                             v=arr[:, 1:])
 
-    def load_model(self, path: str) -> None:
+    def load_model(self, path: str, expect_key_fold: str = "") -> None:
         data = np.load(path)
         slots = np.array(self.slots)
         slots[:, 0] = data["w"]

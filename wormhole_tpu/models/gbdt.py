@@ -68,11 +68,15 @@ class GBDTConfig:
 @dataclass
 class Tree:
     """Complete binary tree in heap order; internal nodes carry
-    feature/split_bin, leaves carry weight."""
-    feature: jax.Array    # int32 (nnodes,)
-    split_bin: jax.Array  # int32 (nnodes,)  go right iff bin > split_bin
-    is_leaf: jax.Array    # bool  (nnodes,)
-    weight: jax.Array     # f32   (nnodes,)
+    feature/split_bin, leaves carry weight. ``default_right`` is the
+    xgboost missing-value direction: rows WITHOUT the split feature go
+    right iff set (always False for dense data, where nothing is
+    missing)."""
+    feature: jax.Array        # int32 (nnodes,)
+    split_bin: jax.Array      # int32 (nnodes,)  go right iff bin > split_bin
+    is_leaf: jax.Array        # bool  (nnodes,)
+    weight: jax.Array         # f32   (nnodes,)
+    default_right: jax.Array  # bool  (nnodes,)
 
 
 def _grad_hess(margin: jax.Array, labels: jax.Array, objective: str):
@@ -167,6 +171,216 @@ def _predict_trees(feature: jax.Array, split_bin: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# sparse (CSR-entry) core: Criteo-width data without an (n, F) dense
+# matrix. The binned dataset is three flat entry arrays (row, feat, bin)
+# over PRESENT values only; rows missing a split feature route by the
+# node's learned default direction (xgboost's sparsity-aware split,
+# which the reference consumes via external-memory '#dtrain.cache',
+# xgboost/README.md:47-55). Histograms are scatter-adds over entries —
+# E = nnz instead of n*F work and memory.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("num_nodes", "num_bins", "num_feat"))
+def _level_hists_sparse(er: jax.Array, ef: jax.Array, eb: jax.Array,
+                        node: jax.Array, grad: jax.Array, hess: jax.Array,
+                        row_mask: jax.Array, *, num_nodes: int,
+                        num_bins: int, num_feat: int):
+    """LOCAL histograms over CSR entries, plus per-node grad/hess totals
+    (needed to price the missing mass). Padding entries carry ef == -1."""
+    valid = (ef >= 0).astype(jnp.float32)
+    gm = grad * row_mask
+    hm = hess * row_mask
+    flat = (node[er] * (num_feat * num_bins) + jnp.maximum(ef, 0) * num_bins
+            + eb)
+    flat = jnp.where(ef >= 0, flat, 0)
+    ghist = jnp.zeros(num_nodes * num_feat * num_bins, jnp.float32).at[
+        flat].add(gm[er] * valid).reshape(num_nodes, num_feat, num_bins)
+    hhist = jnp.zeros(num_nodes * num_feat * num_bins, jnp.float32).at[
+        flat].add(hm[er] * valid).reshape(num_nodes, num_feat, num_bins)
+    gtot = jnp.zeros(num_nodes, jnp.float32).at[node].add(gm)
+    htot = jnp.zeros(num_nodes, jnp.float32).at[node].add(hm)
+    return ghist, hhist, gtot, htot
+
+
+def _best_splits_sparse(ghist: np.ndarray, hhist: np.ndarray,
+                        gtot_n: np.ndarray, htot_n: np.ndarray,
+                        active: np.ndarray, lam: float, gamma: float,
+                        min_child: float):
+    """Split selection with xgboost's default-direction choice: for every
+    (node, feature, threshold) try the missing mass on the left and on the
+    right, keep the better. Host numpy f64 for cross-rank determinism."""
+    num_nodes, F, num_bins = ghist.shape
+    gl = np.cumsum(ghist.astype(np.float64), axis=-1)
+    hl = np.cumsum(hhist.astype(np.float64), axis=-1)
+    gt = gtot_n.astype(np.float64)[:, None, None]
+    ht = htot_n.astype(np.float64)[:, None, None]
+    gmiss = gt - gl[..., -1:]          # per (node, feat) missing mass
+    hmiss = ht - hl[..., -1:]
+    parent = gt * gt / (ht + lam)
+
+    def gain_of(gL, hL):
+        gR, hR = gt - gL, ht - hL
+        g = gL * gL / (hL + lam) + gR * gR / (hR + lam) - parent
+        ok = (hL >= min_child) & (hR >= min_child)
+        return np.where(ok, g, -np.inf)
+
+    gain_r = gain_of(gl, hl)                      # missing goes right
+    gain_l = gain_of(gl + gmiss, hl + hmiss)      # missing goes left
+    # at the last threshold gain_l is "everything left" (no split), but
+    # gain_r is the genuine PRESENCE split (present left, missing right)
+    # and stays — xgboost's forward enumeration includes it; an empty
+    # right side dies on the min_child hessian check
+    gain_l[..., -1] = -np.inf
+    gain = np.maximum(gain_r, gain_l)
+    flat_gain = gain.reshape(num_nodes, F * num_bins)
+    best = np.argmax(flat_gain, axis=-1)
+    best_gain = np.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+    best_f = (best // num_bins).astype(np.int32)
+    best_b = (best % num_bins).astype(np.int32)
+    nid = np.arange(num_nodes)
+    default_right = (gain_r[nid, best_f, best_b]
+                     >= gain_l[nid, best_f, best_b])
+    do_split = active & (best_gain > gamma) & np.isfinite(best_gain)
+    leaf_w = (-gtot_n / (htot_n + lam)).astype(np.float32)
+    return do_split, best_f, best_b, default_right, leaf_w
+
+
+@partial(jax.jit, static_argnames=("num_rows",))
+def _route_rows_sparse(er: jax.Array, ef: jax.Array, eb: jax.Array,
+                       node: jax.Array, best_f: jax.Array,
+                       best_b: jax.Array, default_right: jax.Array, *,
+                       num_rows: int) -> jax.Array:
+    """go-right bits from sparse entries: each row's bin for its node's
+    split feature is recovered with a scatter-max of (bin+1) over matching
+    entries; 0 = feature absent → the node's default direction."""
+    match = ef == best_f[node[er]]
+    rb = jnp.zeros(num_rows, jnp.int32).at[er].max(
+        jnp.where(match, eb + 1, 0))
+    present = rb > 0
+    go_present = (rb - 1) > best_b[node]
+    return jnp.where(present, go_present,
+                     default_right[node]).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("depth", "num_rows"))
+def _predict_trees_sparse(feature: jax.Array, split_bin: jax.Array,
+                          is_leaf: jax.Array, weight: jax.Array,
+                          default_right: jax.Array, er: jax.Array,
+                          ef: jax.Array, eb: jax.Array, *, depth: int,
+                          num_rows: int) -> jax.Array:
+    """Sparse-entry inference: one scatter-max per level recovers each
+    row's bin of its current node's split feature."""
+
+    def one(feat, sb, leaf, wgt, dr):
+        node = jnp.zeros(num_rows, jnp.int32)
+        for _ in range(depth):
+            match = ef == feat[node[er]]
+            rb = jnp.zeros(num_rows, jnp.int32).at[er].max(
+                jnp.where(match, eb + 1, 0))
+            present = rb > 0
+            go = jnp.where(present, (rb - 1) > sb[node],
+                           dr[node]).astype(jnp.int32)
+            nxt = 2 * node + 1 + go
+            node = jnp.where(leaf[node], node, nxt)
+        return wgt[node]
+
+    per_tree = jax.vmap(one)(feature, split_bin, is_leaf, weight,
+                             default_right)
+    return jnp.sum(per_tree, axis=0)
+
+
+class SparseBins:
+    """Binned CSR dataset: entries (row, feat, bin) of present values,
+    labels, per-ACTIVE-feature cuts. ``ef`` holds compact active-feature
+    ids; ``feat_ids`` maps them back to the original (possibly huge,
+    hashed) id space — histograms are (nodes, n_active, bins), so memory
+    is O(nnz + n_active·bins), never O(n·F) or O(F·bins)."""
+
+    def __init__(self, er: np.ndarray, ef: np.ndarray, eb: np.ndarray,
+                 labels: np.ndarray, cuts: np.ndarray,
+                 feat_ids: np.ndarray):
+        self.er = er.astype(np.int32)
+        self.ef = ef.astype(np.int32)
+        self.eb = eb.astype(np.int32)
+        self.labels = labels.astype(np.float32)
+        self.cuts = cuts              # (n_active, B-1)
+        self.feat_ids = feat_ids      # (n_active,) original ids, sorted
+        self.num_rows = len(labels)
+        self.num_feat = len(feat_ids)
+
+
+def load_sparse_binned(uri: str, data_format: str = "libsvm",
+                       num_bins: int = 256, part: int = 0, nparts: int = 1,
+                       ref: Optional[SparseBins] = None) -> SparseBins:
+    """Stream a sparse uri into entry arrays + quantile cuts without ever
+    densifying. Cuts are per-feature percentiles of PRESENT values
+    (xgboost's sketch semantics); pass the training ``ref`` to bin
+    val/test data with the training sketch (entries of features unseen at
+    train time are dropped, xgboost-like)."""
+    from wormhole_tpu.data.minibatch import MinibatchIter
+    rows_l: List[np.ndarray] = []
+    feats_l: List[np.ndarray] = []
+    vals_l: List[np.ndarray] = []
+    labels_l: List[np.ndarray] = []
+    base = 0
+    for blk in MinibatchIter(uri, part, nparts, data_format, 1 << 16):
+        vals = blk.values_or_ones()
+        nnz_per_row = np.diff(blk.offset)
+        rows_l.append(base + np.repeat(np.arange(blk.size), nnz_per_row))
+        feats_l.append(blk.index.astype(np.int64))
+        vals_l.append(vals.astype(np.float32))
+        labels_l.append(blk.label.copy())
+        base += blk.size
+    if base == 0:
+        raise FileNotFoundError(f"no rows in {uri}")
+    er = np.concatenate(rows_l)
+    ef_orig = np.concatenate(feats_l)
+    ev = np.concatenate(vals_l)
+    labels = np.concatenate(labels_l)
+    if ref is not None:
+        feat_ids, cuts = ref.feat_ids, ref.cuts
+        ef = np.searchsorted(feat_ids, ef_orig)
+        ef = np.clip(ef, 0, len(feat_ids) - 1)
+        keep = feat_ids[ef] == ef_orig   # drop unseen-at-train features
+        er, ef, ev = er[keep], ef[keep], ev[keep]
+    else:
+        # compact the active feature set (the Localizer move): hists and
+        # cuts are indexed by the dense active id
+        feat_ids, ef = np.unique(ef_orig, return_inverse=True)
+        ef = ef.astype(np.int64)
+        cuts = None
+    F = len(feat_ids)
+    if F * num_bins > (1 << 28):
+        raise ValueError(
+            f"{F} active features x {num_bins} bins exceeds the histogram "
+            "budget; lower num_bins or prune/hash the feature space")
+    order = np.lexsort((ev, ef))
+    ef_s, ev_s = ef[order], ev[order]
+    starts = np.searchsorted(ef_s, np.arange(F))
+    ends = np.searchsorted(ef_s, np.arange(F) + 1)
+    lens = ends - starts
+    if cuts is None:
+        # per-feature percentiles via one lexsort: each feature's segment
+        # is sorted, quantile cut positions read out of the sorted values
+        qs = np.linspace(0.0, 1.0, num_bins + 1)[1:-1]
+        cuts = np.zeros((F, num_bins - 1), np.float32)
+        nonempty = lens > 0
+        pos = (starts[:, None]
+               + np.minimum((qs[None, :] * np.maximum(lens, 1)[:, None])
+                            .astype(np.int64),
+                            np.maximum(lens - 1, 0)[:, None]))
+        cuts[nonempty] = ev_s[pos[nonempty]]
+    # bin: #cuts strictly below the value (searchsorted-left semantics),
+    # vectorized in chunks so the (chunk, B-1) compare stays cache-sized
+    eb = np.empty(len(ev), np.int32)
+    CH = 1 << 16
+    for i in range(0, len(ev), CH):
+        sl = slice(i, min(i + CH, len(ev)))
+        eb[sl] = np.sum(cuts[ef[sl]] < ev[sl][:, None], axis=1)
+    return SparseBins(er, ef, eb, labels, cuts, feat_ids)
+
+
+# ---------------------------------------------------------------------------
 # host-side quantile binning (the hist sketch)
 # ---------------------------------------------------------------------------
 
@@ -203,6 +417,7 @@ class GBDT:
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
         self.trees: List[Tree] = []
         self.cuts: Optional[np.ndarray] = None
+        self.feat_ids: Optional[np.ndarray] = None  # sparse path id map
         self.base_margin = float(np.log(cfg.base_score
                                         / (1 - cfg.base_score)))
         self.history: List[float] = []  # train metric per round
@@ -248,6 +463,7 @@ class GBDT:
         split_bin = np.zeros(nnodes, np.int32)
         is_leaf = np.zeros(nnodes, bool)
         weight = np.zeros(nnodes, np.float32)
+        default_right = np.zeros(nnodes, bool)  # dense data: never missing
 
         from wormhole_tpu.parallel.collectives import allreduce_tree
         n = bins.shape[0]
@@ -293,7 +509,8 @@ class GBDT:
         return Tree(feature=jnp.asarray(feature),
                     split_bin=jnp.asarray(split_bin),
                     is_leaf=jnp.asarray(is_leaf),
-                    weight=jnp.asarray(weight))
+                    weight=jnp.asarray(weight),
+                    default_right=jnp.asarray(default_right))
 
     # -- boosting -----------------------------------------------------------
 
@@ -363,7 +580,8 @@ class GBDT:
             tree = self._build_tree(bins, grad, hess, mask)
             # shrink leaf weights by eta (xgboost shrinkage)
             tree = Tree(feature=tree.feature, split_bin=tree.split_bin,
-                        is_leaf=tree.is_leaf, weight=tree.weight * cfg.eta)
+                        is_leaf=tree.is_leaf, weight=tree.weight * cfg.eta,
+                        default_right=tree.default_right)
             self.trees.append(tree)
             margin = margin + _predict_trees(
                 tree.feature[None], tree.split_bin[None],
@@ -386,6 +604,139 @@ class GBDT:
                      else "mse", metric)
             self._save_checkpoint(r + 1)
         return self
+
+    # -- sparse (CSR-entry) training path ------------------------------------
+
+    def _build_tree_sparse(self, er, ef, eb, grad, hess, row_mask,
+                           num_rows: int, num_feat: int) -> Tree:
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        d = cfg.max_depth
+        nnodes = 2 ** (d + 1) - 1
+        feature = np.zeros(nnodes, np.int32)
+        split_bin = np.zeros(nnodes, np.int32)
+        is_leaf = np.zeros(nnodes, bool)
+        weight = np.zeros(nnodes, np.float32)
+        default_right = np.zeros(nnodes, bool)
+        node = jnp.zeros(num_rows, jnp.int32)
+        row_mask = jnp.asarray(row_mask)
+        active = np.ones(1, bool)
+        for depth in range(d + 1):
+            level_nodes = 2 ** depth
+            offset = level_nodes - 1
+            gh, hh, gt, ht = _level_hists_sparse(
+                er, ef, eb, node, grad, hess, row_mask,
+                num_nodes=level_nodes, num_bins=cfg.num_bins,
+                num_feat=num_feat)
+            gh, hh, gt, ht = allreduce_tree(
+                tuple(np.asarray(a) for a in (gh, hh, gt, ht)),
+                self.rt.mesh, compress=cfg.msg_compression)
+            do_split, bf, bb, dr, leaf_w = _best_splits_sparse(
+                gh, hh, gt, ht, active, lam=cfg.reg_lambda,
+                gamma=cfg.gamma, min_child=cfg.min_child_weight)
+            if depth == d:
+                do_split[:] = False
+            ids = offset + np.arange(level_nodes)
+            newly_leaf = active & ~do_split
+            is_leaf[ids[newly_leaf]] = True
+            weight[ids[newly_leaf]] = leaf_w[newly_leaf]
+            feature[ids[do_split]] = bf[do_split]
+            split_bin[ids[do_split]] = bb[do_split]
+            default_right[ids[do_split]] = dr[do_split]
+            if not do_split.any():
+                break
+            go_right = _route_rows_sparse(
+                er, ef, eb, node, jnp.asarray(bf), jnp.asarray(bb),
+                jnp.asarray(dr), num_rows=num_rows)
+            on_split = jnp.asarray(do_split)[node]
+            node = jnp.where(on_split, 2 * node + go_right, 0)
+            row_mask = row_mask * on_split
+            nxt_active = np.zeros(2 * level_nodes, bool)
+            sp = np.nonzero(do_split)[0]
+            nxt_active[2 * sp] = True
+            nxt_active[2 * sp + 1] = True
+            active = nxt_active
+        return Tree(feature=jnp.asarray(feature),
+                    split_bin=jnp.asarray(split_bin),
+                    is_leaf=jnp.asarray(is_leaf),
+                    weight=jnp.asarray(weight),
+                    default_right=jnp.asarray(default_right))
+
+    def fit_sparse(self, data: SparseBins,
+                   sample_mask: Optional[np.ndarray] = None) -> "GBDT":
+        """Train from binned CSR entries — O(nnz) memory and histogram
+        work; rows = this host's dsplit=row shard, with the same per-level
+        cross-host histogram allreduce as the dense path."""
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        self.cuts = data.cuts
+        self.feat_ids = data.feat_ids   # active->original id map for dump
+        # the flat histogram index is int32 on device: the deepest level's
+        # nodes x features x bins must stay under 2^31
+        if (2 ** cfg.max_depth) * data.num_feat * cfg.num_bins >= (1 << 31):
+            raise ValueError(
+                f"2^{cfg.max_depth} nodes x {data.num_feat} features x "
+                f"{cfg.num_bins} bins overflows the int32 histogram "
+                "index; lower max_depth/num_bins or prune features")
+        start_round = 0
+        if cfg.checkpoint_dir:
+            start_round = self._load_checkpoint(data.num_feat)
+        er = jnp.asarray(data.er)
+        ef = jnp.asarray(data.ef)
+        eb = jnp.asarray(data.eb)
+        labels = jnp.asarray(data.labels)
+        mask = jnp.asarray(np.ones(data.num_rows, np.float32)
+                           if sample_mask is None
+                           else np.asarray(sample_mask, np.float32))
+        margin = (jnp.asarray(self._margin_sparse(data, len(self.trees)))
+                  if self.trees
+                  else jnp.full(data.num_rows, self.base_margin))
+        for r in range(start_round, cfg.num_round):
+            grad, hess = _grad_hess(margin, labels, cfg.objective)
+            tree = self._build_tree_sparse(er, ef, eb, grad, hess, mask,
+                                           data.num_rows, data.num_feat)
+            tree = Tree(feature=tree.feature, split_bin=tree.split_bin,
+                        is_leaf=tree.is_leaf, weight=tree.weight * cfg.eta,
+                        default_right=tree.default_right)
+            self.trees.append(tree)
+            margin = margin + _predict_trees_sparse(
+                tree.feature[None], tree.split_bin[None],
+                tree.is_leaf[None], tree.weight[None],
+                tree.default_right[None], er, ef, eb,
+                depth=cfg.max_depth + 1, num_rows=data.num_rows)
+            den_l = float(jnp.sum(mask))
+            if cfg.objective == "binary:logistic":
+                num_l = float(logloss(labels, margin, mask)) * den_l
+            else:
+                num_l = float(jnp.sum((margin - labels) ** 2 * mask))
+            num, den = allreduce_tree(
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+            metric = float(num) / max(float(den), 1.0)
+            self.history.append(metric)
+            log.info("round %d: train %s=%.6f", r,
+                     "logloss" if cfg.objective == "binary:logistic"
+                     else "mse", metric)
+            self._save_checkpoint(r + 1)
+        return self
+
+    def _margin_sparse(self, data: SparseBins,
+                       upto: Optional[int] = None) -> np.ndarray:
+        trees = self.trees[:upto] if upto is not None else self.trees
+        if not trees:
+            return np.full(data.num_rows, self.base_margin, np.float32)
+        f, s, l, w, dr = (jnp.stack([t.feature for t in trees]),
+                          jnp.stack([t.split_bin for t in trees]),
+                          jnp.stack([t.is_leaf for t in trees]),
+                          jnp.stack([t.weight for t in trees]),
+                          jnp.stack([t.default_right for t in trees]))
+        return np.asarray(self.base_margin + _predict_trees_sparse(
+            f, s, l, w, dr, jnp.asarray(data.er), jnp.asarray(data.ef),
+            jnp.asarray(data.eb), depth=self.cfg.max_depth + 1,
+            num_rows=data.num_rows))
+
+    def evaluate_sparse(self, data: SparseBins) -> dict:
+        return self._merged_metrics(jnp.asarray(self._margin_sparse(data)),
+                                    jnp.asarray(data.labels))
 
     # -- inference ----------------------------------------------------------
 
@@ -411,8 +762,10 @@ class GBDT:
         """Metrics over (x, y); in a multi-process run x is this host's
         shard and the returned metrics are MERGED across hosts (summed
         logloss/accuracy, histogram-pooled AUC — dist_monitor semantics)."""
-        m = jnp.asarray(self.predict_margin(x))
-        labels = jnp.asarray(y, jnp.float32)
+        return self._merged_metrics(jnp.asarray(self.predict_margin(x)),
+                                    jnp.asarray(y, jnp.float32))
+
+    def _merged_metrics(self, m: jax.Array, labels: jax.Array) -> dict:
         mask = jnp.ones_like(labels)
         if jax.process_count() == 1:
             return {"auc": float(auc(labels, m, mask)),
@@ -441,7 +794,8 @@ class GBDT:
         zt = Tree(feature=np.zeros(nnodes, np.int32),
                   split_bin=np.zeros(nnodes, np.int32),
                   is_leaf=np.zeros(nnodes, bool),
-                  weight=np.zeros(nnodes, np.float32))
+                  weight=np.zeros(nnodes, np.float32),
+                  default_right=np.zeros(nnodes, bool))
         return zt
 
     def _load_checkpoint(self, num_features: int) -> int:
@@ -480,13 +834,19 @@ class GBDT:
                 sb = np.asarray(t.split_bin)
                 leaf = np.asarray(t.is_leaf)
                 wgt = np.asarray(t.weight)
+                dr = np.asarray(t.default_right)
                 for i in range(len(feat)):
                     if leaf[i]:
                         fh.write(f"{i}:leaf={wgt[i]:.6g}\n")
                     elif _node_reachable(leaf, i):
                         cut = self._cut_value(feat[i], sb[i])
-                        fh.write(f"{i}:[f{feat[i]}<{cut:.6g}] "
-                                 f"yes={2 * i + 1},no={2 * i + 2}\n")
+                        miss = 2 * i + 2 if dr[i] else 2 * i + 1
+                        fid = (int(self.feat_ids[feat[i]])
+                               if self.feat_ids is not None
+                               else int(feat[i]))
+                        fh.write(f"{i}:[f{fid}<{cut:.6g}] "
+                                 f"yes={2 * i + 1},no={2 * i + 2},"
+                                 f"missing={miss}\n")
 
     def _cut_value(self, f: int, b: int) -> float:
         cuts = self.cuts[f]
@@ -536,12 +896,14 @@ class _GBDTCLI(GBDTConfig):
     model_dump: str = ""
     mesh_shape: str = ""
     num_features: int = 0
+    sparse: bool = False   # CSR-entry path: O(nnz) memory, missing-aware
+                           # splits (use for wide/hashed feature spaces)
 
 
 def main(argv=None) -> int:
     """CLI (reference mushroom.hadoop.conf ergonomics):
     python -m wormhole_tpu.models.gbdt data=<uri> num_round=10 max_depth=6
-        [val_data=<uri>] [model_dump=<uri>]"""
+        [val_data=<uri>] [model_dump=<uri>] [sparse=true]"""
     import sys
     from wormhole_tpu.utils.config import apply_kvs
     cli = _GBDTCLI()
@@ -549,24 +911,38 @@ def main(argv=None) -> int:
     if not cli.data:
         raise SystemExit("need data=<uri>")
     rt = MeshRuntime.create(cli.mesh_shape)
+    from wormhole_tpu.parallel.collectives import allreduce_tree
     # each process reads its dsplit=row shard (RowBlockIter rank/world)
     part, nparts = rt.local_part()
-    x, y = load_dense(cli.data, cli.data_format, cli.num_features,
-                      part, nparts)
-    if rt.world > 1 and not cli.num_features:
-        # hosts must agree on the column count (the reference's
-        # rabit::Allreduce<op::Max> of num-cols, lbfgs-linear/linear.cc:110)
-        from wormhole_tpu.parallel.collectives import allreduce_tree
-        F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max"))
-        if x.shape[1] < F:
-            x = np.pad(x, ((0, 0), (0, F - x.shape[1])))
     model = GBDT(cli, rt)
-    model.fit(x, y)
-    log.info("train metrics: %s", model.evaluate(x, y))
-    if cli.val_data:
-        xv, yv = load_dense(cli.val_data, cli.data_format, x.shape[1],
-                            part, nparts)
-        log.info("val metrics: %s", model.evaluate(xv, yv))
+    if cli.sparse:
+        if rt.world > 1:
+            raise NotImplementedError(
+                "sparse=true multi-process needs globally agreed cuts; "
+                "run single-process or use the dense path")
+        data = load_sparse_binned(cli.data, cli.data_format, cli.num_bins,
+                                  part, nparts)
+        model.fit_sparse(data)
+        log.info("train metrics: %s", model.evaluate_sparse(data))
+        if cli.val_data:
+            dv = load_sparse_binned(cli.val_data, cli.data_format,
+                                    cli.num_bins, part, nparts, ref=data)
+            log.info("val metrics: %s", model.evaluate_sparse(dv))
+    else:
+        x, y = load_dense(cli.data, cli.data_format, cli.num_features,
+                          part, nparts)
+        if rt.world > 1 and not cli.num_features:
+            # hosts must agree on the column count (the reference's
+            # rabit::Allreduce<op::Max>, lbfgs-linear/linear.cc:110)
+            F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max"))
+            if x.shape[1] < F:
+                x = np.pad(x, ((0, 0), (0, F - x.shape[1])))
+        model.fit(x, y)
+        log.info("train metrics: %s", model.evaluate(x, y))
+        if cli.val_data:
+            xv, yv = load_dense(cli.val_data, cli.data_format, x.shape[1],
+                                part, nparts)
+            log.info("val metrics: %s", model.evaluate(xv, yv))
     if cli.model_dump:
         model.dump_model(cli.model_dump)
     return 0

@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from wormhole_tpu.data.feed import DenseBatch
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc, logloss
+from wormhole_tpu.ops.spmv import spmv_times, spmv_trans_times
 from wormhole_tpu.parallel.collectives import allreduce_tree
 from wormhole_tpu.parallel.mesh import MODEL_AXIS, MeshRuntime
 from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
@@ -41,24 +42,22 @@ _MAGIC = b"WHLF"  # wormhole linear format ("binf" analogue, linear.cc:86-98)
 @partial(jax.jit, static_argnames=("objv_fn", "dual_fn"))
 def _grad_batch(w, batch: DenseBatch, objv_fn, dual_fn):
     """One batch of CalcGrad (linear.cc:158-207): margin, objv, Xᵀ·dual."""
-    margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+    margin = spmv_times(batch.cols, batch.vals, w)
     objv = objv_fn(margin, batch.labels, batch.row_mask)
     dual = dual_fn(margin, batch.labels, batch.row_mask)
-    contrib = batch.vals * dual[:, None]
-    grad = jnp.zeros_like(w).at[batch.cols.reshape(-1)].add(
-        contrib.reshape(-1))
+    grad = spmv_trans_times(batch.cols, batch.vals, dual, w.shape[0])
     return objv, grad
 
 
 @partial(jax.jit, static_argnames=("objv_fn",))
 def _objv_batch(w, batch: DenseBatch, objv_fn):
-    margin = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+    margin = spmv_times(batch.cols, batch.vals, w)
     return objv_fn(margin, batch.labels, batch.row_mask)
 
 
 @jax.jit
 def _margin_batch(w, batch: DenseBatch):
-    return jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+    return spmv_times(batch.cols, batch.vals, w)
 
 
 @partial(jax.jit, static_argnames=("objv_fn",))

@@ -33,6 +33,7 @@ from wormhole_tpu.learners.store import (TableCheckpoint,
                                           shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
+from wormhole_tpu.ops.spmv import spmv_times
 from wormhole_tpu.parallel.mesh import MeshRuntime
 
 
@@ -96,7 +97,7 @@ class WideDeepStore(TableCheckpoint):
     def _forward(self, theta, mlp, batch: SparseBatch):
         w = theta[:, 0]
         v = theta[:, 1:]
-        wide = jnp.einsum("bn,bn->b", batch.vals, w[batch.cols])
+        wide = spmv_times(batch.cols, batch.vals, w)
         pooled = jnp.einsum("bnk,bn->bk", v[batch.cols], batch.vals)
         deep = mlp_forward(mlp, pooled, self.n_layers)
         return wide + deep
@@ -189,7 +190,8 @@ class WideDeepStore(TableCheckpoint):
         self.mlp = jax.tree.map(jnp.asarray, state["mlp"])
         self.mlp_accum = jax.tree.map(jnp.asarray, state["accum"])
 
-    def save_model(self, path: str, rank: Optional[int] = None) -> None:
+    def save_model(self, path: str, rank: Optional[int] = None,
+                   key_fold: str = "") -> None:
         if rank is None:
             rank = jax.process_index()
         k = self.cfg.dim
@@ -198,7 +200,7 @@ class WideDeepStore(TableCheckpoint):
         np.savez_compressed(f"{path}_{rank}.npz", w=arr[:, 0],
                             v=arr[:, 1:], **dense)
 
-    def load_model(self, path: str) -> None:
+    def load_model(self, path: str, expect_key_fold: str = "") -> None:
         data = np.load(path)
         slots = np.array(self.slots)
         slots[:, 0] = data["w"]

@@ -19,8 +19,10 @@ def auc(labels: jax.Array, margin: jax.Array, mask: jax.Array) -> jax.Array:
     total negative weight ranked strictly below it, normalized by W⁺·W⁻.
     Ties are broken by sort order (same as the reference's sort-based
     computation, evaluation.h:38-68). Masked rows carry weight 0 and never
-    contribute. Returns 0.5 when either class is empty (matching the
-    reference's degenerate behavior of an undefined AUC)."""
+    contribute. Returns 0.5 when either class is empty — a deliberate
+    divergence: evaluation.h returns 1 for an empty class and flips
+    area<0.5 to 1-area; this implementation reports the true (unflipped)
+    AUC and the coin-flip value for the undefined case."""
     pos_w = (labels > 0.5).astype(jnp.float32) * mask
     neg_w = mask - pos_w
     order = jnp.argsort(jnp.where(mask > 0, margin, -jnp.inf))

@@ -85,6 +85,11 @@ class Config:
     # device — so there is no repeated key vector to cache. COMPRESSING
     # survives as `msg_compression` below, applied to the host-collective
     # payloads on the DCN path; FIXING_FLOAT as `fixed_bytes`.)
+    # bounded staleness: max device steps in flight. Single-host process()
+    # gates BEFORE dispatch (the reference parses the next minibatch while
+    # steps fly, async_sgd.h:81), so 0 and 1 behave identically — device
+    # steps on one chip serialize anyway; the multihost pass gates AFTER
+    # dispatch, where max_delay=0 means fully synchronous global steps.
     max_delay: int = 0
     msg_compression: bool = False  # zlib-compress host-collective payloads
     fixed_bytes: int = 1

@@ -175,6 +175,34 @@ class AsyncSGD:
             if kind == TRAIN:  # eval metrics must not pollute train rows
                 self._display(local)
 
+        # delay-tolerant DT2 trains through the SPLIT pull/push pipeline:
+        # the pull computes the gradient + snapshot now, the push applies
+        # it up to max_delay batches later — real interleaved staleness,
+        # which the handle's cross-term corrects (delay_tol_handle.h
+        # semantics; the fused step would have no gap to compensate)
+        from wormhole_tpu.learners.handles import DT2AdaGradHandle
+        use_dt2 = (kind == TRAIN
+                   and isinstance(getattr(self.store, "handle", None),
+                                  DT2AdaGradHandle)
+                   and hasattr(self.store, "dt2_pull"))
+        if use_dt2:
+            pfx = ""
+            for batch in self._batches(file, part, nparts, pfx):
+                with self.timer.scope("dispatch"):
+                    grad, snap, metrics = self.store.dt2_pull(batch)
+                    inflight.append((batch, grad, snap, metrics))
+                with self.timer.scope("wait"):
+                    while len(inflight) > max(max_delay - 1, 0):
+                        b, g, s, m = inflight.popleft()
+                        self.store.dt2_push(b, g, s)
+                        harvest((m, None, None))
+            with self.timer.scope("wait"):
+                while inflight:
+                    b, g, s, m = inflight.popleft()
+                    self.store.dt2_push(b, g, s)
+                    harvest((m, None, None))
+            return local
+
         # eval records under its own prefix so the training pipeline
         # profile (the thing SURVEY §5.1 wants) stays unskewed
         pfx = "" if kind == TRAIN else "eval_"
@@ -387,10 +415,12 @@ class AsyncSGD:
         from wormhole_tpu.data.crec import PackedFeed
         from wormhole_tpu.ops.metrics import auc_from_hist
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-PROCESS crec2 training is not wired yet; use the "
-                "sparse formats for multihost runs or a single process "
-                "with a multi-device mesh")
+            # unreachable from run() (run_multihost handles crec2 via
+            # _multihost_pass_crec2); direct process() callers must go
+            # through the multihost pass for collective alignment
+            raise RuntimeError(
+                "call run()/run_multihost for multi-process crec2 — "
+                "process() is single-process only")
         D = self.rt.data_axis_size
         spec = info.spec
         pfx = "" if kind == TRAIN else "eval_"
@@ -759,22 +789,183 @@ class AsyncSGD:
                 harvest(jax.block_until_ready(inflight.popleft()))
         return local
 
+    def _multihost_pass_crec2(self, pattern: str, kind: str,
+                              pooled: Optional[list] = None) -> Progress:
+        """One synchronized crec2 pass across processes: every host runs
+        the replicated pool, streams blocks of its claimed part, and the
+        hosts' stacked blocks become ONE data-axis-sharded global input to
+        the mesh tile step (model axis shards bucket tiles; a host with no
+        block this round contributes all-PAD blocks, which vanish from
+        every product)."""
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+        from wormhole_tpu.data.crec import PackedFeed, read_header2
+        from wormhole_tpu.data.stream import list_files
+        from wormhole_tpu.ops.metrics import auc_from_hist
+        cfg = self.cfg
+        world = self.rt.world
+        dpa = self.rt.data_axis_size
+        dlocal = dpa // world          # data-axis indices per host
+        pool = WorkloadPool(straggler_factor=float("inf"))
+        pool.add(pattern, cfg.num_parts_per_file, kind)
+        # headers are geometry-identical across a dataset's files (the nb
+        # check below re-verifies per opened file)
+        info = read_header2(list_files(pattern)[0].path)
+        my_it = None
+        my_wl = None
+        drained = False
+        finished_id = -1
+        local = Progress()
+        hist_tot = [np.zeros(512), np.zeros(512)]
+        pfx = "" if kind == TRAIN else "eval_"
+
+        def feed_iter(wl):
+            hdr = read_header2(wl.file)
+            same = (hdr.nb == cfg.num_buckets and hdr.spec == info.spec
+                    and hdr.block_rows == info.block_rows
+                    and hdr.nnz == info.nnz
+                    and hdr.ovf_cap == info.ovf_cap)
+            if not same:
+                raise ValueError(
+                    f"{wl.file}: crec2 geometry (nb={hdr.nb}, "
+                    f"spec={hdr.spec}, rows={hdr.block_rows}, "
+                    f"nnz={hdr.nnz}, ovf={hdr.ovf_cap}) does not match "
+                    f"the dataset's first file — multihost block shards "
+                    f"must be shape-identical across hosts")
+            # host arrays only; the global device_put happens at assembly
+            return iter(PackedFeed(wl.file, wl.part, wl.nparts,
+                                   fmt="crec2", device_put=lambda x: x))
+
+        spec = info.spec
+        oc = max(info.ovf_cap, 1)
+        pads = (np.full(spec.pairs_shape, np.uint16(0xFFFF), np.uint16),
+                np.zeros(spec.pairs_shape, np.uint16),
+                np.full(info.block_rows, 255, np.uint8),
+                np.full(oc, 0xFFFFFFFF, np.uint32),
+                np.zeros(oc, np.uint32))
+
+        def pad_block():
+            return {"hl": pads[0], "rd": pads[1], "labels": pads[2],
+                    "ovf_b": pads[3], "ovf_r": pads[4]}
+
+        pending: list = []   # train metric vectors awaiting one stacked D2H
+
+        def drain_pending() -> None:
+            if not pending:
+                return
+            import jax.numpy as jnp
+            rows = jax.device_get(jnp.stack(pending))
+            for row in rows:
+                local.objv += float(row[0])
+                local.num_ex += int(row[1])
+                local.count += 1
+                local.acc += float(row[2])
+                local.wdelta2 += float(row[3])
+                bins = (len(row) - 4) // 2
+                hist_tot[0] += row[4:4 + bins]
+                hist_tot[1] += row[4 + bins:]
+            local.auc = auc_from_hist(*hist_tot) * local.count
+            pending.clear()
+            self._display(local)
+
+        def collect(group):
+            nonlocal my_it, finished_id
+            while my_it is not None and len(group) < dlocal:
+                with self.timer.scope(pfx + "parse"):
+                    item = next(my_it, None)
+                if item is None:
+                    finished_id = my_wl.id
+                    my_it = None
+                else:
+                    group.append(item[0])
+
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        while True:
+            group: list = []
+            collect(group)
+            need = my_it is None and not drained
+            status = multihost_utils.process_allgather(
+                np.asarray([finished_id, int(need), int(drained)],
+                           np.int64))
+            finished_id = -1
+            for r in range(world):
+                if status[r, 0] >= 0:
+                    pool.finish(int(status[r, 0]))
+            for r in range(world):
+                if status[r, 1]:
+                    wl = pool.get(f"proc{r}")
+                    if r == self.rt.rank:
+                        my_wl = wl
+            if need:
+                if my_wl is None:
+                    drained = True
+                else:
+                    my_it = feed_iter(my_wl)
+                    collect(group)   # contribute in the claim round too
+            have = int(allreduce_tree(np.int64(len(group)), self.rt.mesh,
+                                      "sum"))
+            if have == 0:
+                if bool(np.all(status[:, 2])) and not need:
+                    break
+                continue
+            while len(group) < dlocal:
+                group.append(pad_block())
+            blocks = {k: np.stack([v.get(k, pads[3] if k == "ovf_b"
+                                         else pads[4])
+                                   for v in group])
+                      for k in ("hl", "rd", "labels", "ovf_b", "ovf_r")}
+            gblocks = multihost_utils.host_local_array_to_global_array(
+                blocks, self.rt.mesh, P(DATA_AXIS))
+            with self.timer.scope(pfx + "dispatch"):
+                if kind == TRAIN:
+                    pending.append(
+                        self.store.tile_train_step_mesh(gblocks, info))
+                    if self.reporter.due():
+                        with self.timer.scope(pfx + "wait"):
+                            drain_pending()
+                else:
+                    m = self.store.tile_eval_step_mesh(gblocks, info)
+                    local.objv += float(np.asarray(m[0]))
+                    local.num_ex += int(np.asarray(m[1]))
+                    local.count += 1
+                    local.acc += float(np.asarray(m[2]))
+                    local.auc += auc_from_hist(np.asarray(m[3]),
+                                               np.asarray(m[4]))
+                    if pooled is not None:
+                        margins = self._my_shard_rows(m[5])
+                        labs = np.concatenate([v["labels"] for v in group])
+                        real = labs != 255
+                        pooled.append(
+                            (margins[real],
+                             np.minimum(labs[real], 1).astype(np.float32),
+                             np.ones(int(real.sum()), np.float32)))
+        with self.timer.scope(pfx + "wait"):
+            drain_pending()
+        return local
+
     def run_multihost(self) -> Progress:
         """Multi-host scheduler loop: dynamic workload pool, per-pass
         sharded checkpoint/resume, validation passes, divergence kill
         switch, predict — the full AsyncSGDScheduler surface
-        (async_sgd.h:245-348) in SPMD form."""
+        (async_sgd.h:245-348) in SPMD form. Sparse/text formats train
+        through the global-batch path; crec2 trains through the mesh tile
+        step with per-host block shards."""
         from wormhole_tpu.parallel.checkpoint import ShardCheckpointer
         from wormhole_tpu.parallel.collectives import allreduce_tree
         from wormhole_tpu.ops.metrics import auc_np
         cfg = self.cfg
-        if cfg.data_format in ("crec", "crec2"):
+        crec2 = cfg.data_format == "crec2"
+        if cfg.data_format == "crec":
             raise NotImplementedError(
-                "multi-PROCESS crec/crec2 training is not wired yet: use "
-                "sparse/text formats across hosts, or crec2 on a single "
-                "process with a multi-device mesh (the shard_map tile "
-                "step)")
-        if not (cfg.max_nnz and cfg.key_pad):
+                "multi-PROCESS crec(v1) training is not wired: convert to "
+                "crec2 (tile step) or use the sparse/text formats")
+        if crec2:
+            if self.rt.data_axis_size % self.rt.world:
+                raise ValueError(
+                    f"data axis {self.rt.data_axis_size} must be a "
+                    f"multiple of world {self.rt.world} for crec2 "
+                    "multihost (whole blocks per data index)")
+        elif not (cfg.max_nnz and cfg.key_pad):
             raise ValueError("multi-host sync training needs static "
                              "max_nnz= and key_pad= config")
         self._slot = self._host_slot()
@@ -803,7 +994,9 @@ class AsyncSGD:
         last_saved = start_pass
         completed = start_pass
         for data_pass in range(start_pass, cfg.max_data_pass):
-            prog = self._multihost_pass(cfg.train_data, TRAIN)
+            prog = (self._multihost_pass_crec2(cfg.train_data, TRAIN)
+                    if crec2
+                    else self._multihost_pass(cfg.train_data, TRAIN))
             self.progress.merge(prog)
             self._check_divergence(prog)
             completed = data_pass + 1
@@ -814,7 +1007,10 @@ class AsyncSGD:
                 last_saved = completed
             if cfg.val_data:
                 pooled: list = []
-                vp = self._multihost_pass(cfg.val_data, VAL, pooled)
+                vp = (self._multihost_pass_crec2(cfg.val_data, VAL,
+                                                 pooled)
+                      if crec2
+                      else self._multihost_pass(cfg.val_data, VAL, pooled))
                 pass_auc = self._allreduce_pooled_auc(pooled)
                 n = max(vp.num_ex, 1)
                 log.info("pass %d validation: objv=%.6f auc=%.6f "
@@ -833,7 +1029,10 @@ class AsyncSGD:
         if cfg.test_data:
             from wormhole_tpu.sched.workload_pool import TEST
             pooled = []
-            self._multihost_pass(cfg.test_data, TEST, pooled)
+            if crec2:
+                self._multihost_pass_crec2(cfg.test_data, TEST, pooled)
+            else:
+                self._multihost_pass(cfg.test_data, TEST, pooled)
             self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
         if cfg.model_out:
             self._store_io("save", cfg.model_out)

@@ -154,19 +154,42 @@ class DTAdaGradHandle(Handle):
 
 @dataclass(frozen=True)
 class DT2AdaGradHandle(Handle):
-    """Accumulator cross-term correction (delay_tol_handle.h:70-111): the
-    gradient g_bak remembered from the previous push of the same key set
-    corrects cg² by 2·g·g_bak, compensating what the stale pull missed."""
+    """Delay-compensated AdaGrad (DTAdaGradHandle2,
+    delay_tol_handle.h:20-111). The reference keys a per-(sender,
+    keyset-signature) memory of each key's CUMULATIVE gradient at pull
+    time; at push, ``grad_bck = gsum_now − gsum_at_pull`` is the mass
+    OTHER workers applied between this worker's pull and push, and the
+    update corrects the accumulator by the cross-term ``2·g·grad_bck``
+    plus a weight term for the learning-rate shift.
 
-    val_len: int = 3
+    Here the signature map is unnecessary: the driver's split pull/push
+    pipeline (ShardedStore.dt2_pull/dt2_push) carries the pull-time
+    ``gsum`` snapshot WITH the in-flight batch, so the correction is
+    exact per batch — no hash collisions, no per-sender state. Slots:
+    [w, gsum, cg2, cg2max] (val[0..3] of the reference handle)."""
 
-    def push(self, slots, grad, t, tau):
-        w, cg, g_bak = slots[..., 0], slots[..., 1], slots[..., 2]
-        cg2 = jnp.maximum(cg * cg + grad * grad + 2.0 * grad * g_bak, 0.0)
-        cg_new = jnp.sqrt(cg2)
-        eta = self.lr.alpha / (self.lr.beta + cg_new)
-        w_new = self.penalty.solve(w / eta - grad, 1.0 / eta)
-        return jnp.stack([w_new, cg_new, grad], axis=-1)
+    val_len: int = 4
+
+    def push(self, slots, grad, t, tau, gsum_snap=None):
+        """Without ``gsum_snap`` (the fused single-program paths) gbak is
+        exactly 0 — NOT a degradation: a fused step has no pull→push gap,
+        so there is no interleaved mass to compensate and the update is
+        plain AdaGrad, which is the correct limit of the recurrence."""
+        w, gsum = slots[..., 0], slots[..., 1]
+        cg2, cg2max = slots[..., 2], slots[..., 3]
+        gbak = (gsum - gsum_snap) if gsum_snap is not None \
+            else jnp.zeros_like(grad)
+        cg2_new = cg2 + grad * grad + 2.0 * grad * gbak
+        # eta here is the reference's DIVISOR form: sqrt(cg2max+beta)/alpha
+        d_old = jnp.sqrt(cg2max + self.lr.beta) / self.lr.alpha
+        cg2max_new = jnp.maximum(cg2max, cg2_new)
+        d = jnp.sqrt(cg2max_new + self.lr.beta) / self.lr.alpha
+        # first-ever push with lr_beta=0 has d_old=0; gbak is 0 there, so
+        # the correction term is defined as 0 (guard the 0*inf)
+        corr = jnp.where(d_old > 0.0, gbak * (d / d_old - 1.0), 0.0)
+        w_new = self.penalty.solve(d * w - grad + corr, d)
+        return jnp.stack([w_new, gsum + grad, cg2_new, cg2max_new],
+                         axis=-1)
 
 
 _HANDLES = {

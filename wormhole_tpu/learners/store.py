@@ -514,6 +514,59 @@ class ShardedStore(TableCheckpoint):
     def tile_eval_step(self, block: dict, info):
         return self._tile_step(info, "eval")(self.slots, block)
 
+    # -- split pull/push pipeline (delay-tolerant DT2 path) -----------------
+    #
+    # The fused step has no pull→push gap, so the staleness DT2
+    # compensates cannot arise there. This pair reintroduces the
+    # reference worker's real pipeline (async_sgd.h:57-127): ``dt2_pull``
+    # computes the gradient against the CURRENT weights and snapshots
+    # each key's cumulative-gradient slot; other batches' pushes may land
+    # before the matching ``dt2_push`` applies the update, and the handle
+    # corrects for exactly that interleaved mass.
+
+    def _build_dt2(self):
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+
+        @jax.jit
+        def pull(slots, batch: SparseBatch):
+            rows = slots[batch.uniq_keys].astype(jnp.float32)
+            w = handle.weights(rows)
+            margin = spmv_times(batch.cols, batch.vals, w)
+            objv = objv_fn(margin, batch.labels, batch.row_mask)
+            dual = dual_fn(margin, batch.labels, batch.row_mask)
+            grad = spmv_trans_times(batch.cols, batch.vals, dual,
+                                    w.shape[0])
+            snap = rows[:, 1]                      # gsum at pull time
+            num_ex = jnp.sum(batch.row_mask)
+            a = auc(batch.labels, margin, batch.row_mask)
+            acc = accuracy(batch.labels, margin, batch.row_mask)
+            return grad, snap, (objv, num_ex, a, acc)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def push(slots, uniq_keys, key_mask, grad, snap):
+            rows = slots[uniq_keys].astype(jnp.float32)
+            # DT2's recurrence depends on the snapshot only (the t/tau
+            # schedule knobs belong to the DT-SGD variants)
+            new_rows = handle.push(rows, grad, jnp.float32(0),
+                                   jnp.float32(0), gsum_snap=snap)
+            delta = (new_rows - rows) * key_mask[:, None]
+            return slots.at[uniq_keys].add(delta.astype(slots.dtype))
+
+        return pull, push
+
+    def dt2_pull(self, batch: SparseBatch):
+        """ZPull + gradient compute; returns (grad, gsum snapshot,
+        metrics) for a later dt2_push of the same batch."""
+        if not hasattr(self, "_dt2"):
+            self._dt2 = self._build_dt2()
+        return self._dt2[0](self.slots, batch)
+
+    def dt2_push(self, batch: SparseBatch, grad, snap) -> None:
+        """ZPush: apply the delayed gradient with its pull-time snapshot."""
+        self.slots = self._dt2[1](
+            self.slots, batch.uniq_keys, batch.key_mask, grad, snap)
+        self.t += 1
+
     # -- the ZPush/ZPull surface --------------------------------------------
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):

@@ -13,7 +13,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_mp(n: int, body: str, timeout=240) -> str:
+def run_mp(n: int, body: str, timeout=240, launcher_args=(),
+           raw=False):
+    """Run ``body`` under the mp launcher. ``raw=True`` returns the
+    CompletedProcess (for tests asserting on stderr/returncode)."""
     script = os.path.join(REPO, ".pytest_cache", f"mp_body_{os.getpid()}.py")
     os.makedirs(os.path.dirname(script), exist_ok=True)
     with open(script, "w") as f:
@@ -22,8 +25,11 @@ def run_mp(n: int, body: str, timeout=240) -> str:
            if k not in ("XLA_FLAGS",)}  # children get their own device count
     r = subprocess.run(
         [sys.executable, "-m", "wormhole_tpu.parallel.launcher",
-         "-n", str(n), "--cluster", "mp", "--", sys.executable, script],
+         "-n", str(n), "--cluster", "mp", *launcher_args, "--",
+         sys.executable, script],
         capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+    if raw:
+        return r
     assert r.returncode == 0, r.stdout + r.stderr
     return r.stdout
 
@@ -267,3 +273,39 @@ def test_mp_kmeans_two_hosts(tmp_path):
     objvs = {ln.split("objv=")[1] for ln in out.splitlines()
              if "objv=" in ln}
     assert len(objvs) == 1, out
+
+
+def test_mp_restarts_resume_after_crash(tmp_path):
+    """Fault injection (the reference's tracker-relaunch + rabit restart
+    story): rank 1 kills itself mid-training on the first attempt; the
+    launcher's --restarts relaunches the whole job, which resumes from
+    the last committed checkpoint version instead of pass 0."""
+    rng = np.random.default_rng(6)
+    pattern = _learnable_libsvm(tmp_path, rng, n_files=1, rows=200)
+    marker = tmp_path / "crashed_once"
+    body = f"""
+        import os, sys
+        from wormhole_tpu.learners.async_sgd import AsyncSGD
+        from wormhole_tpu.utils.config import load_config
+        cfg = load_config(None, {CFG_COMMON.split()!r} + [
+            "train_data={pattern}", "max_data_pass=4",
+            "checkpoint_dir={tmp_path}/ckpt"])
+        app = AsyncSGD(cfg)
+        if not os.path.exists("{marker}") and app.rt.rank == 1:
+            # crash AFTER pass-2 checkpoints exist: run 2 passes, die
+            cfg2 = cfg.merged(["max_data_pass=2"])
+            app2 = AsyncSGD(cfg2, app.rt, store=app.store)
+            app2.run()
+            open("{marker}", "w").close()
+            os._exit(17)
+        prog = app.run()
+        print(f"OK rank {{app.rt.rank}} num_ex={{prog.num_ex}}")
+    """
+    r = run_mp(2, body, timeout=420, launcher_args=("--restarts", "2"),
+               raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "restart 1/2" in r.stderr, r.stderr
+    assert marker.exists()
+    # the retry resumed at pass 2: ranks trained only passes 2-3
+    num_ex = int(r.stdout.split("num_ex=")[1].split()[0])
+    assert num_ex == 2 * 200, r.stdout

@@ -13,6 +13,13 @@ roles collapse into one SPMD program, so the launcher's jobs are:
 - ``--cluster tpu``   : exec the app unchanged on every host of a pod slice
   (the pod runtime injects coordinator/topology; we only validate env).
 
+``--restarts K`` is the elastic-recovery hook (reference: the tracker
+relaunching failed nodes + rabit checkpoint restart, workload_pool.h:111 +
+lbfgs.h:120-125): if the job exits nonzero, the WHOLE job is relaunched up
+to K times — apps configured with ``checkpoint_dir`` resume from their
+last committed version, which is the recovery model JAX multihost implies
+(a lost process cannot rejoin a live mesh; SURVEY §5.3/§7 hard part (e)).
+
 Usage:  python -m wormhole_tpu.parallel.launcher -n 8 [--cluster sim] -- \
             python your_app.py key=val ...
 """
@@ -69,9 +76,35 @@ def launch_mp(n: int, cmd: List[str]) -> int:
         env["NUM_PROCESSES"] = str(n)
         env["PROCESS_ID"] = str(i)
         procs.append(subprocess.Popen(cmd, env=env))
+    import time as _time
     rc = 0
-    for p in procs:
-        rc = p.wait() or rc
+    try:
+        # poll ALL ranks: as soon as any child dies nonzero, the rest are
+        # wedged on collectives waiting for it — terminate them NOW so the
+        # failed JOB exits promptly and a restart can rebuild the whole
+        # mesh (SURVEY §5.3 recovery model; waiting on the jax
+        # coordination-service heartbeat instead costs minutes)
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                rc = code or rc
+                if code != 0:
+                    for q in live:
+                        q.terminate()
+            _time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
     return rc
 
 
@@ -88,6 +121,9 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("-n", "--num-devices", type=int, default=8,
                     help="virtual devices (sim) or processes (mp)")
     ap.add_argument("--cluster", choices=("sim", "mp", "tpu"), default="sim")
+    ap.add_argument("--restarts", type=int, default=0,
+                    help="relaunch a failed job up to K times (apps with "
+                         "checkpoint_dir resume from the last version)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- command to launch")
     args = ap.parse_args(argv)
@@ -96,11 +132,18 @@ def main(argv: List[str] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("no command given (append: -- python app.py ...)")
-    if args.cluster == "sim":
-        return launch_sim(args.num_devices, cmd)
-    if args.cluster == "mp":
-        return launch_mp(args.num_devices, cmd)
-    return launch_tpu(cmd)
+    run = {"sim": lambda: launch_sim(args.num_devices, cmd),
+           "mp": lambda: launch_mp(args.num_devices, cmd),
+           "tpu": lambda: launch_tpu(cmd)}[args.cluster]
+    rc = run()
+    attempt = 0
+    while rc != 0 and attempt < args.restarts:
+        attempt += 1
+        print(f"[launcher] job failed (rc={rc}); restart "
+              f"{attempt}/{args.restarts} — checkpointed apps resume",
+              file=sys.stderr)
+        rc = run()
+    return rc
 
 
 if __name__ == "__main__":

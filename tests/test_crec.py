@@ -92,8 +92,7 @@ def test_dense_apply_matches_sparse_path(tmp_path, rng):
 
     loc = Localizer(num_buckets=0)
     for packed, rows in iter_packed(str(path)):
-        dense.dense_train_step(jnp.asarray(packed), info.block_rows, N,
-                               donate_packed=False)
+        dense.dense_train_step(jnp.asarray(packed), info.block_rows, N)
         keys, labels = unpack_block(packed, info)
         valid = keys != SENTINEL_KEY
         buckets = fold_keys32(keys.ravel(), NB).reshape(keys.shape)
@@ -119,7 +118,7 @@ def test_dense_apply_guard():
     store = ShardedStore(StoreConfig(num_buckets=64),
                          AdaGradHandle(penalty=L1L2(0.5, 0.0)))
     with pytest.raises(ValueError):
-        store._dense_step(8, 4, "train", False)
+        store._dense_step(8, 4, "train")
 
 
 def test_key64_to_key32_never_sentinel(rng):
@@ -153,8 +152,7 @@ def test_dense_apply_learns(tmp_path, rng):
     for _ in range(3):
         last = []
         for packed, rows_n in iter_packed(path):
-            m = store.dense_train_step(jnp.asarray(packed), R, N,
-                                       donate_packed=False)
+            m = store.dense_train_step(jnp.asarray(packed), R, N)
             last.append(float(np.asarray(m[2])))
         aucs.append(np.mean(last))
     assert aucs[-1] > 0.8, aucs

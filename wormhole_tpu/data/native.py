@@ -35,6 +35,24 @@ def _find_lib() -> Optional[str]:
     return None
 
 
+def _try_build() -> Optional[str]:
+    """Best-effort one-shot `make` of the native library (a fresh checkout
+    has no build/ — the hot path should not silently fall back to Python
+    parsing on machines that have a toolchain)."""
+    import subprocess
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    ndir = os.path.join(here, "native")
+    if not os.path.exists(os.path.join(ndir, "Makefile")):
+        return None
+    try:
+        subprocess.run(["make", "-C", ndir], capture_output=True,
+                       timeout=120, check=True)
+    except Exception:
+        return None
+    return _find_lib()
+
+
 def _load():
     global _LIB, _TRIED
     if _TRIED:
@@ -42,7 +60,7 @@ def _load():
     _TRIED = True
     if os.environ.get("WORMHOLE_DISABLE_NATIVE"):
         return None
-    path = _find_lib()
+    path = _find_lib() or _try_build()
     if path is None:
         return None
     try:

@@ -388,8 +388,7 @@ class AsyncSGD:
                 elif kind == TRAIN:
                     m = self.store.dense_train_step(
                         dev, info.block_rows, info.nnz,
-                        tau=min(float(len(inflight)), tau_cap),
-                        donate_packed=not cfg.cache_device)
+                        tau=min(float(len(inflight)), tau_cap))
                     inflight.append((m, None))
                 else:
                     m = self.store.dense_eval_step(dev, info.block_rows,

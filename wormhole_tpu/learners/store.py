@@ -136,6 +136,35 @@ class TableCheckpoint:
         else:
             self.slots = put_like(self.slots, np.asarray(slots))
         self.t = int(state["t"])
+        self._t_dev = None           # re-seed the device clock
+
+    # -- device-resident step clock -----------------------------------------
+    #
+    # A fresh host scalar upload per dispatched step costs a full
+    # host<->device round trip (~30 ms measured through a tunneled
+    # transport) and serializes the dispatch loop. The update counter
+    # therefore LIVES ON DEVICE and rides the donated step chain (each
+    # train step returns t+1); tau takes a handful of small values and is
+    # served from a cache of device constants.
+
+    def _t_device(self):
+        if getattr(self, "_t_dev", None) is None:
+            self._t_dev = jnp.asarray(float(self.t), jnp.float32)
+        return self._t_dev
+
+    def _advance_t(self, t_new) -> None:
+        self._t_dev = t_new
+        self.t += 1
+
+    def _tau_const(self, tau: float):
+        cache = getattr(self, "_tau_cache", None)
+        if cache is None:
+            cache = self._tau_cache = {}
+        v = cache.get(tau)
+        if v is None:
+            theta = getattr(self.cfg, "lr_theta", 1.0)
+            v = cache[tau] = jnp.asarray(tau * theta, jnp.float32)
+        return v
 
 
 class ShardedStore(TableCheckpoint):
@@ -163,7 +192,7 @@ class ShardedStore(TableCheckpoint):
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         fixed_bytes = self.cfg.fixed_bytes
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0, 2))
         def step(slots, batch: SparseBatch, t, tau):
             # pull (gather); compute in f32 regardless of storage dtype
             rows = slots[batch.uniq_keys].astype(jnp.float32)
@@ -183,7 +212,7 @@ class ShardedStore(TableCheckpoint):
             a = auc(batch.labels, margin, batch.row_mask)
             acc = accuracy(batch.labels, margin, batch.row_mask)
             wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
-            return slots, (objv, num_ex, a, acc, wdelta2)
+            return slots, t + 1.0, (objv, num_ex, a, acc, wdelta2)
 
         return step
 
@@ -211,9 +240,8 @@ class ShardedStore(TableCheckpoint):
     # path whenever zero-grad pushes are no-ops (supports_dense_apply);
     # sentinel keys (missing criteo slots) and padded tail rows are masked.
 
-    def _dense_step(self, block_rows: int, nnz: int, kind: str,
-                    donate_packed: bool):
-        key = (block_rows, nnz, kind, donate_packed)
+    def _dense_step(self, block_rows: int, nnz: int, kind: str):
+        key = (block_rows, nnz, kind)
         fn = getattr(self, "_dense_cache", {}).get(key)
         if fn is not None:
             return fn
@@ -241,9 +269,10 @@ class ShardedStore(TableCheckpoint):
             return b, vf, labels, row_mask, margin
 
         if kind == "train":
-            donate = (0, 1) if donate_packed else (0,)
+            # NOT donating `packed`: no output aliases it, so the donation
+            # would be unusable (XLA warns and copies anyway)
 
-            @partial(jax.jit, donate_argnums=donate)
+            @partial(jax.jit, donate_argnums=(0, 2))
             def step(slots, packed, t, tau):
                 b, vf, labels, row_mask, margin = fold_and_forward(slots,
                                                                   packed)
@@ -257,7 +286,7 @@ class ShardedStore(TableCheckpoint):
                 a = auc(labels, margin, row_mask)
                 acc = accuracy(labels, margin, row_mask)
                 d0 = new[:, 0] - s32[:, 0]
-                return (new.astype(slots.dtype),
+                return (new.astype(slots.dtype), t + 1.0,
                         (objv, num_ex, a, acc, jnp.sum(d0 * d0)))
         else:
             @jax.jit
@@ -276,21 +305,17 @@ class ShardedStore(TableCheckpoint):
         return step
 
     def dense_train_step(self, packed: jax.Array, block_rows: int,
-                         nnz: int, tau: float = 0.0,
-                         donate_packed: bool = True):
-        """Fused crec-block step. ``packed`` is the device-resident raw
-        block buffer; it is DONATED by default (dead after the call) — the
-        streaming feed never reuses a block, and donation avoids a
-        defensive input copy on some transports."""
-        step = self._dense_step(block_rows, nnz, "train", donate_packed)
-        self.slots, metrics = step(
-            self.slots, packed, jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
-        self.t += 1
+                         nnz: int, tau: float = 0.0):
+        """Fused crec-block step over the device-resident raw block
+        buffer."""
+        step = self._dense_step(block_rows, nnz, "train")
+        self.slots, t_new, metrics = step(
+            self.slots, packed, self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def dense_eval_step(self, packed: jax.Array, block_rows: int, nnz: int):
-        return self._dense_step(block_rows, nnz, "eval", False)(
+        return self._dense_step(block_rows, nnz, "eval")(
             self.slots, packed)
 
     # -- tile-blocked MXU step: the crec2 streaming fast path ---------------
@@ -328,7 +353,7 @@ class ShardedStore(TableCheckpoint):
             return block["hl"], block["rd"], labels, row_mask, ovf_b, ovf_r
 
         if kind == "train":
-            @partial(jax.jit, donate_argnums=(0,))
+            @partial(jax.jit, donate_argnums=(0, 2))
             def step(slots, block, t, tau):
                 hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
                 s32 = slots.astype(jnp.float32)
@@ -351,7 +376,7 @@ class ShardedStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new.astype(slots.dtype), packed
+                return new.astype(slots.dtype), t + 1.0, packed
         else:
             @jax.jit
             def step(slots, block):
@@ -453,24 +478,32 @@ class ShardedStore(TableCheckpoint):
                            wdelta2]),
                 jax.lax.psum(pos, DATA_AXIS),
                 jax.lax.psum(neg, DATA_AXIS)])
-            return new.astype(slots_l.dtype), packed
+            return new.astype(slots_l.dtype), t + 1.0, packed
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
         Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
                 else P(DATA_AXIS, None, None, None))
-        in_specs = (Pm, Pblk, Pblk, P(DATA_AXIS, None),
-                    P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P())
+        data_specs = (Pm, Pblk, Pblk, P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(DATA_AXIS, None))
         if kind == "train":
-            out_specs = (Pm, P())
+            in_specs = data_specs + (P(), P())
+            out_specs = (Pm, P(), P())
+            fn = body
         else:
+            # eval takes no clock args (the t/tau params are train-only)
+            in_specs = data_specs
             out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
+
+            def fn(s, hl_, rd_, lab_, ovb_, ovr_):
+                return body(s, hl_, rd_, lab_, ovb_, ovr_,
+                            jnp.float32(0), jnp.float32(0))
         step = jax.jit(
-            shard_map(body, mesh=mesh, in_specs=in_specs,
+            shard_map(fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False),
-            # donate slots only when the step returns them (train); the
-            # eval step has no aliasable output, so donating would leave
-            # self.slots pointing at a donated buffer
-            donate_argnums=(0,) if kind == "train" else ())
+            # donate slots/clock only when the step returns them (train);
+            # the eval step has no aliasable output, so donating would
+            # leave self.slots pointing at a donated buffer
+            donate_argnums=(0, 6) if kind == "train" else ())
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}
         self._tile_cache[key] = step
@@ -484,12 +517,11 @@ class ShardedStore(TableCheckpoint):
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
         z = np.zeros((D, max(oc, 1)), np.uint32)
-        self.slots, metrics = step(
+        self.slots, t_new, metrics = step(
             self.slots, blocks["hl"], blocks["rd"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
-            jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
-        self.t += 1
+            self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def tile_eval_step_mesh(self, blocks: dict, info):
@@ -505,10 +537,9 @@ class ShardedStore(TableCheckpoint):
         shipped to device); returns (objv, num_ex, acc, pos_hist, neg_hist,
         wdelta2) — AUC comes from the merged histograms."""
         step = self._tile_step(info, "train")
-        self.slots, metrics = step(
-            self.slots, block, jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
-        self.t += 1
+        self.slots, t_new, metrics = step(
+            self.slots, block, self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def tile_eval_step(self, block: dict, info):
@@ -571,10 +602,9 @@ class ShardedStore(TableCheckpoint):
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):
         """Dispatch one fused step; returns the (async) metrics tuple."""
-        self.slots, metrics = self._step(
-            self.slots, batch, jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau * self.cfg.lr_theta, jnp.float32))
-        self.t += 1
+        self.slots, t_new, metrics = self._step(
+            self.slots, batch, self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def eval_step(self, batch: SparseBatch):

@@ -88,7 +88,7 @@ class FMStore(TableCheckpoint):
         objv_fn = self.objv_fn
         penalty = L1L2(cfg.l1, cfg.l2)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0, 2))
         def step(slots, batch: SparseBatch, t, tau):
             rows = slots[batch.uniq_keys]              # (kpad, 2(1+k))
             theta, cg = rows[:, :1 + k], rows[:, 1 + k:]
@@ -117,7 +117,7 @@ class FMStore(TableCheckpoint):
             acc = accuracy(batch.labels, margin, batch.row_mask)
             # w column only — comparable with the linear store's metric
             wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
-            return slots, (objv, num_ex, a, acc, wdelta2)
+            return slots, t + 1.0, (objv, num_ex, a, acc, wdelta2)
 
         return step
 
@@ -140,10 +140,9 @@ class FMStore(TableCheckpoint):
     # -- ShardedStore surface ------------------------------------------------
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):
-        self.slots, metrics = self._step(
-            self.slots, batch, jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau, jnp.float32))
-        self.t += 1
+        self.slots, t_new, metrics = self._step(
+            self.slots, batch, self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def eval_step(self, batch: SparseBatch):

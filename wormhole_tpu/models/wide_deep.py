@@ -108,7 +108,7 @@ class WideDeepStore(TableCheckpoint):
         objv_fn = self.objv_fn
         forward = self._forward
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 4))
         def step(slots, mlp, accum, batch: SparseBatch, t, tau):
             rows = slots[batch.uniq_keys]
             theta, cg = rows[:, :1 + k], rows[:, 1 + k:]
@@ -143,7 +143,7 @@ class WideDeepStore(TableCheckpoint):
             acc = accuracy(batch.labels, margin, batch.row_mask)
             # w column only — comparable with the linear store's metric
             wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
-            return slots, mlp, accum, (objv, num_ex, a_, acc, wdelta2)
+            return slots, mlp, accum, t + 1.0, (objv, num_ex, a_, acc, wdelta2)
 
         return step
 
@@ -167,11 +167,10 @@ class WideDeepStore(TableCheckpoint):
     # -- ShardedStore surface ------------------------------------------------
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):
-        self.slots, self.mlp, self.mlp_accum, metrics = self._step(
+        self.slots, self.mlp, self.mlp_accum, t_new, metrics = self._step(
             self.slots, self.mlp, self.mlp_accum, batch,
-            jnp.asarray(float(self.t), jnp.float32),
-            jnp.asarray(tau, jnp.float32))
-        self.t += 1
+            self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
         return metrics
 
     def eval_step(self, batch: SparseBatch):

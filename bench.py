@@ -131,6 +131,7 @@ def bench_e2e_crec2(path: str) -> dict:
     cold_s = time.perf_counter() - t0
     cold_rows = prog.num_ex
     app.process(path, 0, 1)               # warm the cached-replay path
+    app.flush_metrics()                   # don't credit warmup rows below
     app.timer.totals.clear()
     app.timer.counts.clear()
     t0 = time.perf_counter()
@@ -145,6 +146,10 @@ def bench_e2e_crec2(path: str) -> dict:
     jax.block_until_ready(app.store.slots)
     float(np.asarray(app.store.slots[0, 0]))
     elapsed = time.perf_counter() - t0
+    # cached replay defers per-part metric fetches; the flushed tail's
+    # rows were computed inside the window (the slots read above proves
+    # the steps completed) — count them, after the clock stops
+    rows += app.flush_metrics().num_ex
     prof = {k: round(app.timer.totals.get(k, 0.0), 3)
             for k in ("put", "dispatch", "wait")}
     from wormhole_tpu.data.crec import read_header2

@@ -38,7 +38,9 @@ def _find_lib() -> Optional[str]:
 def _try_build() -> Optional[str]:
     """Best-effort one-shot `make` of the native library (a fresh checkout
     has no build/ — the hot path should not silently fall back to Python
-    parsing on machines that have a toolchain)."""
+    parsing on machines that have a toolchain). A file lock serializes
+    concurrent builders (multi-process launches on a fresh checkout would
+    otherwise clobber each other's half-written .so)."""
     import subprocess
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -46,9 +48,18 @@ def _try_build() -> Optional[str]:
     if not os.path.exists(os.path.join(ndir, "Makefile")):
         return None
     try:
-        subprocess.run(["make", "-C", ndir], capture_output=True,
-                       timeout=120, check=True)
-    except Exception:
+        import fcntl
+        with open(os.path.join(ndir, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)   # waits for a peer's build
+            found = _find_lib()
+            if found:                          # a peer built it first
+                return found
+            subprocess.run(["make", "-C", ndir], capture_output=True,
+                           timeout=120, check=True)
+    except Exception as e:
+        import logging
+        logging.getLogger("wormhole_tpu.native").warning(
+            "native build failed (%s); falling back to Python parsers", e)
         return None
     return _find_lib()
 

@@ -104,6 +104,11 @@ class AsyncSGD:
         self.model_monitor = ModelMonitor()
         self.reporter = TimeReporter(self._emit_row, interval=cfg.disp_itv)
         self.timer = Timer()  # pipeline stage profile (SURVEY §5.1)
+        # deferred crec2 metric window (cache_device replay): fetching the
+        # metrics of every part costs a device round trip per part; the
+        # window persists across parts and drains at disp_itv / flush
+        self._crec_pending: list = []
+        self._crec_hist = [np.zeros(512), np.zeros(512)]
         from wormhole_tpu.parallel.checkpoint import Checkpointer
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
         self._warned_ckpt = False
@@ -247,6 +252,52 @@ class AsyncSGD:
             self._feeds[key] = feed
         return feed
 
+    # deferred-window geometry: drain in FIXED-size stacks so jnp.stack
+    # compiles once, and cap the window so the host can't run unboundedly
+    # ahead of the device (each pending entry is one dispatched step)
+    CREC_DRAIN_CHUNK = 64
+
+    def _drain_crec2_train(self, local: Progress) -> None:
+        """Fetch the deferred crec2-train metric window in fixed-size
+        stacked device reads, accumulating into ``local`` (AUC comes from
+        the RUNNING margin histograms, stored as auc*count so Progress
+        merges reproduce the pass-level number)."""
+        pending = self._crec_pending
+        if not pending:
+            return
+        import jax.numpy as jnp
+        from wormhole_tpu.ops.metrics import auc_from_hist
+        C = self.CREC_DRAIN_CHUNK
+        while pending:
+            chunk = pending[:C]
+            del pending[:len(chunk)]
+            if len(chunk) == 1:
+                rows = [jax.device_get(chunk[0][0])]
+            else:
+                # pad short tails by repeating the last entry so only two
+                # stack shapes ever compile (C and the 1-case above)
+                padded = chunk + [chunk[-1]] * (C - len(chunk))
+                rows = jax.device_get(
+                    jnp.stack([p[0] for p in padded]))[:len(chunk)]
+            for row in rows:
+                local.objv += float(row[0])
+                local.num_ex += int(row[1])
+                local.count += 1
+                local.acc += float(row[2])
+                local.wdelta2 += float(row[3])
+                bins = (len(row) - 4) // 2
+                self._crec_hist[0] += row[4:4 + bins]
+                self._crec_hist[1] += row[4 + bins:]
+        local.auc = (auc_from_hist(*self._crec_hist) * local.count)
+        self._display(local)
+
+    def flush_metrics(self) -> Progress:
+        """Drain any deferred crec2 metrics; returns the tail Progress
+        (callers merge it into their running totals)."""
+        tail = Progress()
+        self._drain_crec2_train(tail)
+        return tail
+
     def _process_crec(self, file: str, part: int, nparts: int,
                       kind: str, pooled: Optional[list]) -> Progress:
         """The crec/crec2 streaming fast path: packed block bytes go
@@ -285,8 +336,10 @@ class AsyncSGD:
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         tau_cap = float(max(cfg.max_delay - 1, 0))
         inflight: deque = deque()
-        pending: list = []   # device metric tuples awaiting one batched D2H
-        hist_tot = [np.zeros(512), np.zeros(512)]  # running pos/neg hists
+        # crec2-train metrics accumulate in the app-level deferred window
+        # (survives across parts); eval/v1 metrics stay part-local
+        pending = (self._crec_pending if fmt == "crec2" and kind == TRAIN
+                   else [])
         local = Progress()
 
         def drain_pending() -> None:
@@ -299,23 +352,7 @@ class AsyncSGD:
             if not pending:
                 return
             if fmt == "crec2" and kind == TRAIN:
-                import jax.numpy as jnp
-                rows = jax.device_get(jnp.stack([p[0] for p in pending]))
-                for row in rows:
-                    local.objv += float(row[0])
-                    local.num_ex += int(row[1])
-                    local.count += 1
-                    local.acc += float(row[2])
-                    local.wdelta2 += float(row[3])
-                    bins = (len(row) - 4) // 2
-                    hist_tot[0] += row[4:4 + bins]
-                    hist_tot[1] += row[4 + bins:]
-                # pass-level AUC from the RUNNING histogram totals; kept
-                # as auc*count so Progress's auc/count display (and merge
-                # across parts) reproduces the pass-level number
-                local.auc = (auc_from_hist(*hist_tot) * local.count)
-                pending.clear()
-                self._display(local)
+                self._drain_crec2_train(local)
                 return
             fetched = jax.device_get([p[0] for p in pending])
             for (mdev, labels_u8), metrics in zip(pending, fetched):
@@ -363,7 +400,10 @@ class AsyncSGD:
         pfx = "" if kind == TRAIN else "eval_"
         feed = self._feed(file, part, nparts, fmt)
         put_before = feed.put_time
-        if getattr(feed, "_cache_full", False):
+        # snapshot BEFORE iterating: the feed flips _cache_full as its
+        # stream exhausts, which is mid-way through THIS part
+        replay = getattr(feed, "_cache_full", False)
+        if replay:
             # HBM-resident replay: single-device steps serialize on the
             # donated slots chain anyway, so the staleness window only
             # throttles host buffering of in-flight blocks — and cached
@@ -400,7 +440,15 @@ class AsyncSGD:
             # full round trip on a tunneled transport
             while inflight:
                 pending.append(inflight.popleft())
-            drain_pending()
+            if fmt == "crec2" and kind == TRAIN and replay:
+                # HBM-resident replay: leave the window deferred — the
+                # end-of-part fetch is a full round trip per part; the
+                # caller's flush_metrics()/disp_itv drains it — but bound
+                # the window so dispatch can't run unboundedly ahead
+                if len(pending) >= self.CREC_DRAIN_CHUNK:
+                    drain_pending()
+            else:
+                drain_pending()
         self.timer.add(pfx + "put", feed.put_time - put_before)
         return local
 
@@ -566,6 +614,11 @@ class AsyncSGD:
                 pass_prog.merge(prog)
                 self.pool.finish(wl.id)
                 self._check_divergence(prog)
+            tail = self.flush_metrics()
+            self.progress.merge(tail)
+            pass_prog.merge(tail)
+            self._check_divergence(tail)   # deferred metrics still feed
+            self._crec_hist = [np.zeros(512), np.zeros(512)]  # pass-level
             nnz = self.store.nnz_weight()
             self.model_monitor.update_delta(
                 nnz, self.model_monitor.prog.nnz_w,

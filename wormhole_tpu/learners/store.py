@@ -148,8 +148,9 @@ class TableCheckpoint:
     # served from a cache of device constants.
 
     def _t_device(self):
+        # int32 on device: a float32 counter freezes at 2^24 (t+1 == t)
         if getattr(self, "_t_dev", None) is None:
-            self._t_dev = jnp.asarray(float(self.t), jnp.float32)
+            self._t_dev = jnp.asarray(self.t, jnp.int32)
         return self._t_dev
 
     def _advance_t(self, t_new) -> None:
@@ -204,7 +205,8 @@ class ShardedStore(TableCheckpoint):
                                     w.shape[0])
             if fixed_bytes:
                 grad = quantize_dequantize(grad, 8 * fixed_bytes)
-            new_rows = handle.push(rows, grad, t, tau)
+            new_rows = handle.push(rows, grad,
+                                   t.astype(jnp.float32), tau)
             delta = (new_rows - rows) * batch.key_mask[:, None]
             slots = slots.at[batch.uniq_keys].add(          # push (scatter)
                 delta.astype(slots.dtype))
@@ -212,7 +214,7 @@ class ShardedStore(TableCheckpoint):
             a = auc(batch.labels, margin, batch.row_mask)
             acc = accuracy(batch.labels, margin, batch.row_mask)
             wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
-            return slots, t + 1.0, (objv, num_ex, a, acc, wdelta2)
+            return slots, t + 1, (objv, num_ex, a, acc, wdelta2)
 
         return step
 
@@ -281,12 +283,12 @@ class ShardedStore(TableCheckpoint):
                 contrib = (dual[:, None] * vf).reshape(-1)
                 grad = jnp.zeros((nb,), jnp.float32).at[b].add(contrib)
                 s32 = slots.astype(jnp.float32)
-                new = handle.push(s32, grad, t, tau)
+                new = handle.push(s32, grad, t.astype(jnp.float32), tau)
                 num_ex = jnp.sum(row_mask)
                 a = auc(labels, margin, row_mask)
                 acc = accuracy(labels, margin, row_mask)
                 d0 = new[:, 0] - s32[:, 0]
-                return (new.astype(slots.dtype), t + 1.0,
+                return (new.astype(slots.dtype), t + 1,
                         (objv, num_ex, a, acc, jnp.sum(d0 * d0)))
         else:
             @jax.jit
@@ -364,7 +366,8 @@ class ShardedStore(TableCheckpoint):
                 dual = dual_fn(margin, labels, row_mask)
                 grad = tilemm.backward_grad(hl, rd, dual, spec,
                                             ovf_b, ovf_r)
-                new = handle.push(s32, grad, t, tau)
+                new = handle.push(s32, grad, t.astype(jnp.float32),
+                                  tau)
                 num_ex = jnp.sum(row_mask)
                 acc = accuracy(labels, margin, row_mask)
                 pos, neg = margin_hist(labels, margin, row_mask)
@@ -376,7 +379,7 @@ class ShardedStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new.astype(slots.dtype), t + 1.0, packed
+                return new.astype(slots.dtype), t + 1, packed
         else:
             @jax.jit
             def step(slots, block):
@@ -466,7 +469,7 @@ class ShardedStore(TableCheckpoint):
                 dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
                 g = g.at[idx].add(dv)
             g = jax.lax.psum(g, DATA_AXIS)
-            new = handle.push(s32, g, t, tau)
+            new = handle.push(s32, g, t.astype(jnp.float32), tau)
             d0 = new[:, 0] - s32[:, 0]
             wdelta2 = jnp.sum(d0 * d0)
             if have_model:
@@ -478,7 +481,7 @@ class ShardedStore(TableCheckpoint):
                            wdelta2]),
                 jax.lax.psum(pos, DATA_AXIS),
                 jax.lax.psum(neg, DATA_AXIS)])
-            return new.astype(slots_l.dtype), t + 1.0, packed
+            return new.astype(slots_l.dtype), t + 1, packed
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
         Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model

@@ -117,7 +117,7 @@ class FMStore(TableCheckpoint):
             acc = accuracy(batch.labels, margin, batch.row_mask)
             # w column only — comparable with the linear store's metric
             wdelta2 = jnp.sum(delta[:, 0] * delta[:, 0])
-            return slots, t + 1.0, (objv, num_ex, a, acc, wdelta2)
+            return slots, t + 1, (objv, num_ex, a, acc, wdelta2)
 
         return step
 

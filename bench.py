@@ -130,7 +130,17 @@ def bench_e2e_crec2(path: str) -> dict:
     float(np.asarray(app.store.slots[0, 0]))
     cold_s = time.perf_counter() - t0
     cold_rows = prog.num_ex
-    app.process(path, 0, 1)               # warm the cached-replay path
+    # warm the cached-replay path PAST the post-warmup ramp: the first few
+    # hundred steps run ~35% below steady state (device/transport ramp;
+    # round-3 e2etrace measured 12 ms/step cold vs 8.8 ms warm), so burn
+    # ~10 passes before opening the timed window
+    warm_t0 = time.perf_counter()
+    for _ in range(10):
+        app.process(path, 0, 1)
+        if time.perf_counter() - warm_t0 > 25.0:
+            break
+    jax.block_until_ready(app.store.slots)
+    float(np.asarray(app.store.slots[0, 0]))
     app.flush_metrics()                   # don't credit warmup rows below
     app.timer.totals.clear()
     app.timer.counts.clear()

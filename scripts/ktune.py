@@ -1,0 +1,96 @@
+"""Kernel tuning harness for ops/tilemm.py — times fwd/bwd separately
+on real TPU hardware, checks them against the exact numpy oracle, and
+sweeps tiles_step. Not part of the bench; a dev tool.
+
+Usage: python scripts/ktune.py [reps] [tb1,tb2,...]
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from wormhole_tpu.ops import tilemm  # noqa: E402
+
+NB = 1 << 22
+ROWS = 98304
+NNZ = 39
+
+
+def _force(o):
+    """Force real completion: a D2H read of one element (tunnel futures
+    can fake block_until_ready; VERDICT r2)."""
+    float(np.asarray(jax.tree_util.tree_leaves(o)[0].ravel()[0]))
+
+
+def timeit(fn, *args, reps=20):
+    _force(fn(*args))
+    # overhead-cancelled: (t(2n) - t(n)) / n
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(*args)
+        _force(o)
+        return time.perf_counter() - t0
+
+    t1 = run(reps)
+    t2 = run(2 * reps)
+    return max((t2 - t1) / reps, 1e-9)
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    tbs = ([int(x) for x in sys.argv[2].split(",")]
+           if len(sys.argv) > 2 else [])
+    from wormhole_tpu.data.crec import default_cap
+    spec = tilemm.make_spec(NB, ROWS // tilemm.RSUB, default_cap(NNZ, NB))
+    print("spec:", spec)
+
+    rng = np.random.default_rng(0)
+    buckets = rng.integers(0, NB, size=ROWS * NNZ, dtype=np.int64)
+    rows = np.repeat(np.arange(ROWS, dtype=np.int64), NNZ)
+    pw_np, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+    print(f"overflow pairs: {len(ovb)}")
+    w_np = rng.normal(0, 0.1, NB).astype(np.float32)
+    dual_np = rng.normal(0, 1.0, ROWS).astype(np.float32)
+    # device-resident operands: numpy args would re-upload ~90 MB per
+    # call through the host transport and swamp the kernel timing
+    pw, w, dual = (jax.device_put(x) for x in (pw_np, w_np, dual_np))
+
+    slots = spec.tiles * spec.subblocks * spec.cap
+    # MXU N-row pass floor: passes x slots x 16384 MAC @ 98.5e12 MAC/s
+    floor = 3 * slots * 16384 / 98.5e12
+
+    fwd, bwd = tilemm._build_fwd(spec), tilemm._build_bwd(spec)
+    mg = np.asarray(fwd(pw, w))
+    g = np.asarray(bwd(pw, dual))
+    om = tilemm.forward_margins_ref(buckets, rows, w_np, ROWS)
+    og = tilemm.backward_grad_ref(buckets, rows, dual_np, NB)
+    print(f"max|dmargin|={np.max(np.abs(mg - om)):.3e} "
+          f"max|dgrad|={np.max(np.abs(g - og)):.3e} (bf16-value rounding)")
+    t_f = timeit(fwd, pw, w, reps=reps)
+    t_b = timeit(bwd, pw, dual, reps=reps)
+    tot = t_f + t_b
+    print(f"fwd {t_f*1e3:7.3f} ms (floor-frac {floor/t_f:.3f})  "
+          f"bwd {t_b*1e3:7.3f} ms (floor-frac {floor/t_b:.3f})  "
+          f"tot {tot*1e3:.2f} ms -> {ROWS/tot/1e6:.2f} M ex/s")
+
+    for tb in tbs:
+        sp = dataclasses.replace(spec, tiles_step=tb)
+        f2, b2 = tilemm._build_fwd(sp), tilemm._build_bwd(sp)
+        t_f = timeit(f2, pw, w, reps=reps)
+        t_b = timeit(b2, pw, dual, reps=reps)
+        tot = t_f + t_b
+        print(f"TB={tb:2d}: fwd {t_f*1e3:7.3f} bwd {t_b*1e3:7.3f} "
+              f"tot {tot*1e3:.2f} ms -> {ROWS/tot/1e6:.2f} M ex/s")
+
+
+if __name__ == "__main__":
+    main()

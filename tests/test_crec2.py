@@ -48,14 +48,14 @@ def test_roundtrip_pairs(tmp_path, rng):
     assert rows == n
     # decode all pairs back to (bucket, row) and compare multisets
     spec = info.spec
-    hl = views["hl"].reshape(spec.tiles, spec.subblocks, spec.cap)
-    rd = views["rd"].reshape(spec.tiles, spec.subblocks, spec.cap)
+    pw = views["pw"].reshape(spec.tiles, spec.subblocks, spec.cap)
+    bt, rt, pad = tilemm.unpack_fields(pw)
     got = []
     for t in range(spec.tiles):
         for s in range(spec.subblocks):
-            live = hl[t, s] != tilemm.PAD16
-            b = t * tilemm.TILE + hl[t, s][live].astype(np.int64)
-            r = s * tilemm.RSUB + rd[t, s][live].astype(np.int64)
+            live = ~pad[t, s]
+            b = t * tilemm.TILE + bt[t, s][live].astype(np.int64)
+            r = s * tilemm.RSUB + rt[t, s][live].astype(np.int64)
             got += list(zip(b.tolist(), r.tolist()))
     rr, cc = np.nonzero(keys != np.uint32(0xFFFFFFFF))
     want = sorted(zip(fold_keys32(keys[rr, cc], NB).tolist(), rr.tolist()))
@@ -87,9 +87,9 @@ def test_feed_cache_replays(tmp_path, rng):
     path = tmp_path / "c.crec2"
     write_file(path, keys, labels)
     feed = PackedFeed(str(path), fmt="crec2", cache=True)
-    first = [id(d["hl"]) for d, _h, _r in feed]
+    first = [id(d["pw"]) for d, _h, _r in feed]
     assert feed._cache_full
-    second = [id(d["hl"]) for d, _h, _r in feed]
+    second = [id(d["pw"]) for d, _h, _r in feed]
     assert first == second            # same device buffers replayed
     assert feed.bytes_read == read_header2(str(path)).block_bytes
 

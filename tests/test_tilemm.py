@@ -25,19 +25,19 @@ def make_pairs(rng, n_pairs, spec=SPEC, rows_limit=None):
 def test_encode_roundtrip():
     rng = np.random.default_rng(0)
     buckets, rows = make_pairs(rng, 2000)
-    hl, rd, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
-    assert hl.shape == SPEC.pairs_shape
+    pw, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    assert pw.shape == SPEC.pairs_shape
     assert len(ovb) == 0
     # decode every non-pad pair and compare multisets
-    hl_f = hl.reshape(SPEC.tiles, SPEC.subblocks, SPEC.cap)
-    rd_f = rd.reshape(SPEC.tiles, SPEC.subblocks, SPEC.cap)
+    pw_f = pw.reshape(SPEC.tiles, SPEC.subblocks, SPEC.cap)
+    bt, rt, pad = tilemm.unpack_fields(pw_f)
     got = []
     for t in range(SPEC.tiles):
         for s in range(SPEC.subblocks):
             for c in range(SPEC.cap):
-                if hl_f[t, s, c] != tilemm.PAD16:
-                    b = t * tilemm.TILE + int(hl_f[t, s, c])
-                    r = s * tilemm.RSUB + int(rd_f[t, s, c])
+                if not pad[t, s, c]:
+                    b = t * tilemm.TILE + int(bt[t, s, c])
+                    r = s * tilemm.RSUB + int(rt[t, s, c])
                     got.append((b, r))
     want = sorted(zip(buckets.tolist(), rows.tolist()))
     assert sorted(got) == want
@@ -46,11 +46,11 @@ def test_encode_roundtrip():
 def test_forward_backward_match_oracle():
     rng = np.random.default_rng(1)
     buckets, rows = make_pairs(rng, 4000)
-    hl, rd, _, _ = tilemm.encode_block(buckets, rows, SPEC)
+    pw, _, _ = tilemm.encode_block(buckets, rows, SPEC)
     w = (rng.standard_normal(SPEC.nb) * 0.1).astype(np.float32)
     dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
-    mg = np.asarray(tilemm.forward_margins(hl, rd, w, SPEC))
-    g = np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC))
+    mg = np.asarray(tilemm.forward_margins(pw, w, SPEC))
+    g = np.asarray(tilemm.backward_grad(pw, dual, SPEC))
     om = tilemm.forward_margins_ref(buckets, rows, w, SPEC.block_rows)
     og = tilemm.backward_grad_ref(buckets, rows, dual, SPEC.nb)
     # bf16 one-hot matmuls quantize the VALUES (w, dual) to bf16; the
@@ -67,7 +67,7 @@ def test_overflow_spill_exact():
     buckets = np.concatenate([buckets, np.full(1400, hot, np.int64)])
     rows = np.concatenate(
         [rows, rng.integers(0, tilemm.RSUB, size=1400).astype(np.int64)])
-    hl, rd, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    pw, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
     assert len(ovb) > 0                  # hot bucket exceeds cap
     cap_o = 1536
     pad_b = np.full(cap_o, 0xFFFFFFFF, np.uint32)
@@ -75,8 +75,8 @@ def test_overflow_spill_exact():
     pad_b[:len(ovb)], pad_r[:len(ovr)] = ovb, ovr
     w = (rng.standard_normal(SPEC.nb) * 0.1).astype(np.float32)
     dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
-    mg = np.asarray(tilemm.forward_margins(hl, rd, w, SPEC, pad_b, pad_r))
-    g = np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC, pad_b, pad_r))
+    mg = np.asarray(tilemm.forward_margins(pw, w, SPEC, pad_b, pad_r))
+    g = np.asarray(tilemm.backward_grad(pw, dual, SPEC, pad_b, pad_r))
     om = tilemm.forward_margins_ref(buckets, rows, w, SPEC.block_rows)
     og = tilemm.backward_grad_ref(buckets, rows, dual, SPEC.nb)
     assert np.max(np.abs(mg - om)) <= 2e-2 * max(1, np.abs(om).max())
@@ -85,13 +85,12 @@ def test_overflow_spill_exact():
 
 def test_pad_pairs_are_noops():
     """All-pad encoding produces zero margins and zero gradient."""
-    hl = np.full(SPEC.pairs_shape, tilemm.PAD16, np.uint16)
-    rd = np.zeros(SPEC.pairs_shape, np.uint16)
+    pw = np.full(SPEC.pairs_shape, tilemm.PADWORD, np.uint32)
     rng = np.random.default_rng(3)
     w = rng.standard_normal(SPEC.nb).astype(np.float32)
     dual = rng.standard_normal(SPEC.block_rows).astype(np.float32)
-    assert np.all(np.asarray(tilemm.forward_margins(hl, rd, w, SPEC)) == 0)
-    assert np.all(np.asarray(tilemm.backward_grad(hl, rd, dual, SPEC)) == 0)
+    assert np.all(np.asarray(tilemm.forward_margins(pw, w, SPEC)) == 0)
+    assert np.all(np.asarray(tilemm.backward_grad(pw, dual, SPEC)) == 0)
 
 
 def test_mesh_tile_step_matches_oracle():
@@ -119,15 +118,14 @@ def test_mesh_tile_step_matches_oracle():
     store = ShardedStore(StoreConfig(num_buckets=nb, loss="logit"),
                          handle, rt)
 
-    blocks = {"hl": [], "rd": [], "labels": []}
+    blocks = {"pw": [], "labels": []}
     raw = []
     for _ in range(2):
         buckets, rows = make_pairs(rng, 3000, spec)
-        hl, rd, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+        pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
         assert not len(ovb)
         labels = (rng.random(spec.block_rows) < 0.4).astype(np.uint8)
-        blocks["hl"].append(hl)
-        blocks["rd"].append(rd)
+        blocks["pw"].append(pw)
         blocks["labels"].append(labels)
         raw.append((buckets, rows, labels))
     blocks = {k: np.stack(v) for k, v in blocks.items()}

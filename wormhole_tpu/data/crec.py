@@ -213,16 +213,16 @@ def unpack_block(packed: np.ndarray,
 # 16K-bucket tile (ops/tilemm.encode_block). The on-disk bytes are the
 # kernel operands; the device does only dense matmul work.
 #
-#     header (48 B): magic "WCREC\x02\0\0", nnz u32, block_rows u32,
+#     header (48 B): magic "WCREC\x03\0\0", nnz u32, block_rows u32,
 #                    total_rows u64, nb u32, subblocks u32, cap u32,
 #                    ovf_cap u32, reserved u64
 #     per block (fixed size, tail padded at write time):
-#         hi_lo  u16[T * S/GS * N]      rowd  u16[same]
+#         pw     u32[T * S/GS * N]      (packed digit words, tilemm layout)
 #         labels u8[block_rows]         (255 = padded row)
 #         ovf_b  u32[ovf_cap]           (0xFFFFFFFF = unused slot)
 #         ovf_r  u32[ovf_cap]
 
-MAGIC2 = b"WCREC\x02\x00\x00"
+MAGIC2 = b"WCREC\x03\x00\x00"
 _HDR2 = struct.Struct("<8sIIQIIIIQ")
 HEADER2_SIZE = _HDR2.size
 
@@ -245,11 +245,11 @@ class CRec2Info:
     @property
     def pairs_bytes(self) -> int:
         t, sg, n = self.spec.pairs_shape
-        return t * sg * n * 2
+        return t * sg * n * 4
 
     @property
     def block_bytes(self) -> int:
-        return 2 * self.pairs_bytes + self.block_rows + 8 * self.ovf_cap
+        return self.pairs_bytes + self.block_rows + 8 * self.ovf_cap
 
     @property
     def num_blocks(self) -> int:
@@ -337,8 +337,8 @@ class CRec2Writer:
         self._buf_labels[rows:] = PAD_LABEL
         rr, cc = np.nonzero(keys != SENTINEL_KEY)
         buckets = fold_keys32(keys[rr, cc], self.nb)
-        hl, rd, ovb, ovr = encode_block(buckets, rr.astype(np.int64),
-                                        self.spec)
+        pw, ovb, ovr = encode_block(buckets, rr.astype(np.int64),
+                                    self.spec)
         if len(ovb) > self.ovf_cap:
             raise ValueError(
                 f"{self.path}: block overflow {len(ovb)} > ovf_cap "
@@ -346,8 +346,7 @@ class CRec2Writer:
         ob = np.full(self.ovf_cap, 0xFFFFFFFF, np.uint32)
         orow = np.zeros(self.ovf_cap, np.uint32)
         ob[:len(ovb)], orow[:len(ovr)] = ovb, ovr
-        self._f.write(hl.tobytes())
-        self._f.write(rd.tobytes())
+        self._f.write(pw.tobytes())
         self._f.write(self._buf_labels.tobytes())
         self._f.write(ob.tobytes())
         self._f.write(orow.tobytes())
@@ -381,11 +380,10 @@ def block2_views(info: CRec2Info, buf: np.ndarray) -> dict:
     relayout copies in front of the tile kernels (measured ~5ms/block)."""
     pb, R, oc = info.pairs_bytes, info.block_rows, info.ovf_cap
     shape = info.spec.pairs_shape
-    o0 = 2 * pb + R
+    o0 = pb + R
     return {
-        "hl": buf[:pb].view(np.uint16).reshape(shape),
-        "rd": buf[pb:2 * pb].view(np.uint16).reshape(shape),
-        "labels": buf[2 * pb:2 * pb + R],
+        "pw": buf[:pb].view(np.uint32).reshape(shape),
+        "labels": buf[pb:pb + R],
         "ovf_b": buf[o0:o0 + 4 * oc].view(np.uint32),
         "ovf_r": buf[o0 + 4 * oc:o0 + 8 * oc].view(np.uint32),
     }

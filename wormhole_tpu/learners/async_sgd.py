@@ -35,6 +35,7 @@ from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.learners.handles import LearnRate, create_handle
 from wormhole_tpu.learners.store import ShardedStore, StoreConfig
 from wormhole_tpu.ops.penalty import L1L2
+from wormhole_tpu.ops.tilemm import PADWORD
 from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
 from wormhole_tpu.utils.config import Config
@@ -104,10 +105,12 @@ class AsyncSGD:
         self.model_monitor = ModelMonitor()
         self.reporter = TimeReporter(self._emit_row, interval=cfg.disp_itv)
         self.timer = Timer()  # pipeline stage profile (SURVEY §5.1)
-        # deferred crec2 metric window (cache_device replay): fetching the
-        # metrics of every part costs a device round trip per part; the
-        # window persists across parts and drains at disp_itv / flush
-        self._crec_pending: list = []
+        # deferred crec2 metric window: per-step metrics accumulate ON
+        # DEVICE (store.fetch_metrics); the host only counts dispatched
+        # steps and fetches one buffer at disp_itv / flush — fetching
+        # per part (let alone per step) costs a device round trip each
+        self._crec_count = 0
+        self._crec_tickets: list = []   # in-flight async accumulator reads
         self._crec_hist = [np.zeros(512), np.zeros(512)]
         from wormhole_tpu.parallel.checkpoint import Checkpointer
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
@@ -252,44 +255,48 @@ class AsyncSGD:
             self._feeds[key] = feed
         return feed
 
-    # deferred-window geometry: drain in FIXED-size stacks so jnp.stack
-    # compiles once, and cap the window so the host can't run unboundedly
-    # ahead of the device (each pending entry is one dispatched step)
-    CREC_DRAIN_CHUNK = 64
+    # deferred-window geometry: crec2-train metrics accumulate in ONE
+    # on-device buffer; this caps how many steps dispatch between
+    # accumulator fetches so the host can't run unboundedly ahead of the
+    # device (each fetch is one async ticket, resolved a window later)
+    CREC_DRAIN_CHUNK = 64   # max steps dispatched ahead of a metric fetch
 
-    def _drain_crec2_train(self, local: Progress) -> None:
-        """Fetch the deferred crec2-train metric window in fixed-size
-        stacked device reads, accumulating into ``local`` (AUC comes from
-        the RUNNING margin histograms, stored as auc*count so Progress
-        merges reproduce the pass-level number)."""
-        pending = self._crec_pending
-        if not pending:
-            return
-        import jax.numpy as jnp
+    def _harvest_macc(self, local: Progress, hist: list, n_new: int,
+                      final: bool) -> None:
+        """Harvest the on-device metric accumulator into ``local`` — one
+        device read per window, and that read is ASYNC: ``n_new`` pending
+        steps start a fetch immediately (the device never stalls), while
+        the previous window's ticket — which has had a full window of
+        wall-clock to fly home — is resolved. ``final`` resolves
+        everything, blocking (flush/part boundaries). AUC comes from the
+        RUNNING margin histograms in ``hist``, stored as auc*count so
+        Progress merges reproduce the pass-level number. The packed row
+        layout is ShardedStore's: [objv, num_ex, acc, wdelta2, pos, neg]."""
         from wormhole_tpu.ops.metrics import auc_from_hist
-        C = self.CREC_DRAIN_CHUNK
-        while pending:
-            chunk = pending[:C]
-            del pending[:len(chunk)]
-            if len(chunk) == 1:
-                rows = [jax.device_get(chunk[0][0])]
-            else:
-                # pad short tails by repeating the last entry so only two
-                # stack shapes ever compile (C and the 1-case above)
-                padded = chunk + [chunk[-1]] * (C - len(chunk))
-                rows = jax.device_get(
-                    jnp.stack([p[0] for p in padded]))[:len(chunk)]
-            for row in rows:
-                local.objv += float(row[0])
-                local.num_ex += int(row[1])
-                local.count += 1
-                local.acc += float(row[2])
-                local.wdelta2 += float(row[3])
-                bins = (len(row) - 4) // 2
-                self._crec_hist[0] += row[4:4 + bins]
-                self._crec_hist[1] += row[4 + bins:]
-        local.auc = (auc_from_hist(*self._crec_hist) * local.count)
-        self._display(local)
+        if n_new:
+            self._crec_tickets.append(
+                (self.store.fetch_metrics_async(), n_new))
+        resolved = False
+        while self._crec_tickets and (final or len(self._crec_tickets) > 1):
+            ticket, n = self._crec_tickets.pop(0)
+            row = np.asarray(ticket)
+            local.objv += float(row[0])
+            local.num_ex += int(row[1])
+            local.count += n
+            local.acc += float(row[2])
+            local.wdelta2 += float(row[3])
+            bins = (len(row) - 4) // 2
+            hist[0] += row[4:4 + bins]
+            hist[1] += row[4 + bins:]
+            resolved = True
+        if resolved:
+            local.auc = auc_from_hist(*hist) * local.count
+            self._display(local)
+
+    def _drain_crec2_train(self, local: Progress,
+                           final: bool = True) -> None:
+        self._harvest_macc(local, self._crec_hist, self._crec_count, final)
+        self._crec_count = 0
 
     def flush_metrics(self) -> Progress:
         """Drain any deferred crec2 metrics; returns the tail Progress
@@ -336,23 +343,25 @@ class AsyncSGD:
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         tau_cap = float(max(cfg.max_delay - 1, 0))
         inflight: deque = deque()
-        # crec2-train metrics accumulate in the app-level deferred window
-        # (survives across parts); eval/v1 metrics stay part-local
-        pending = (self._crec_pending if fmt == "crec2" and kind == TRAIN
-                   else [])
+        # crec2-train metrics accumulate ON DEVICE (store.fetch_metrics;
+        # the app-level deferred window survives across parts); eval/v1
+        # metrics ride per-step vectors in the part-local pending list
+        acc_metrics = fmt == "crec2" and kind == TRAIN
+        pending: list = []
         local = Progress()
 
-        def drain_pending() -> None:
-            """Fetch ALL pending metrics with minimal host<->device round
-            trips — per-leaf fetches cost one round trip each, which
-            dominates the steady-state loop on a high-latency transport
-            (the axon tunnel; round-3 finding). The crec2 train step packs
-            its metrics into ONE vector, so a whole window drains as a
-            single stacked-buffer fetch."""
-            if not pending:
+        def drain_pending(final: bool = True) -> None:
+            """Harvest metrics with minimal host<->device round trips —
+            per-leaf fetches cost one round trip each, which dominates
+            the steady-state loop on a high-latency transport (the axon
+            tunnel; round-3 finding). crec2-train drains the on-device
+            accumulator (async ticket when ``final`` is False, so the
+            device never stalls mid-stream); eval/v1 paths batch-fetch
+            their per-step metric vectors."""
+            if acc_metrics:
+                self._drain_crec2_train(local, final)
                 return
-            if fmt == "crec2" and kind == TRAIN:
-                self._drain_crec2_train(local)
+            if not pending:
                 return
             fetched = jax.device_get([p[0] for p in pending])
             for (mdev, labels_u8), metrics in zip(pending, fetched):
@@ -383,9 +392,13 @@ class AsyncSGD:
         def harvest(item) -> None:
             m = item[0]
             jax.block_until_ready(m[0] if isinstance(m, tuple) else m)
-            pending.append(item)
+            if not acc_metrics:
+                pending.append(item)
             if kind == TRAIN and self.reporter.due():
-                drain_pending()
+                # mid-stream display drain: non-final for the accumulator
+                # path — a blocking fetch of the just-started window costs
+                # ~100 ms of device idle (part-end/flush drains are final)
+                drain_pending(final=not acc_metrics)
 
         def _labels_of(host) -> np.ndarray:
             if isinstance(host, dict):
@@ -421,6 +434,7 @@ class AsyncSGD:
                         m = self.store.tile_train_step(
                             dev, info,
                             tau=min(float(len(inflight)), tau_cap))
+                        self._crec_count += 1
                         inflight.append((m, None))
                     else:
                         m = self.store.tile_eval_step(dev, info)
@@ -436,17 +450,20 @@ class AsyncSGD:
                     inflight.append((m, _labels_of(host)))
         with self.timer.scope(pfx + "wait"):
             # no per-item block_until_ready here: drain_pending's
-            # device_get synchronizes, and each block_until_ready is a
+            # device fetch synchronizes, and each block_until_ready is a
             # full round trip on a tunneled transport
             while inflight:
-                pending.append(inflight.popleft())
-            if fmt == "crec2" and kind == TRAIN and replay:
+                item = inflight.popleft()
+                if not acc_metrics:
+                    pending.append(item)
+            if acc_metrics and replay:
                 # HBM-resident replay: leave the window deferred — the
-                # end-of-part fetch is a full round trip per part; the
+                # end-of-part fetch is a round trip per part; the
                 # caller's flush_metrics()/disp_itv drains it — but bound
-                # the window so dispatch can't run unboundedly ahead
-                if len(pending) >= self.CREC_DRAIN_CHUNK:
-                    drain_pending()
+                # the window (pipelined, non-final) so dispatch can't run
+                # unboundedly ahead of the device
+                if self._crec_count >= self.CREC_DRAIN_CHUNK:
+                    self._drain_crec2_train(local, final=False)
             else:
                 drain_pending()
         self.timer.add(pfx + "put", feed.put_time - put_before)
@@ -481,56 +498,40 @@ class AsyncSGD:
         # megabytes of throwaway uint16 per step in the hot loop
         ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF, np.uint32)
         ovf_pad_r = np.zeros(max(info.ovf_cap, 1), np.uint32)
-        hl_pad = np.full(spec.pairs_shape, np.uint16(0xFFFF), np.uint16)
-        rd_pad = np.zeros(spec.pairs_shape, np.uint16)
+        pw_pad = np.full(spec.pairs_shape, PADWORD, np.uint32)
         lab_pad = np.full(info.block_rows, 255, np.uint8)
 
         def pad_block():
-            return {"hl": hl_pad, "rd": rd_pad, "labels": lab_pad,
+            return {"pw": pw_pad, "labels": lab_pad,
                     "ovf_b": ovf_pad_b, "ovf_r": ovf_pad_r}
 
-        pending: list = []   # train metric vectors awaiting one batched D2H
+        nsteps = [0]         # train steps since the last accumulator fetch
         hist_tot = [np.zeros(512), np.zeros(512)]
 
-        def drain_pending() -> None:
-            """One stacked-buffer fetch for the whole window (per-step
-            device_get is a full round trip on a tunneled transport and
-            would serialize host against device)."""
-            if not pending:
-                return
-            import jax.numpy as jnp
-            rows = jax.device_get(jnp.stack(pending))
-            for row in rows:
-                local.objv += float(row[0])
-                local.num_ex += int(row[1])
-                local.count += 1
-                local.acc += float(row[2])
-                local.wdelta2 += float(row[3])
-                bins = (len(row) - 4) // 2
-                hist_tot[0] += row[4:4 + bins]
-                hist_tot[1] += row[4 + bins:]
-            # pass-level AUC from running histogram totals, stored as
-            # auc*count so Progress's auc/count display stays correct
-            local.auc = auc_from_hist(*hist_tot) * local.count
-            pending.clear()
-            self._display(local)
+        def drain_pending(final: bool = True) -> None:
+            """Harvest the on-device accumulator via the async ticket
+            pipeline (mid-part windows are non-final so the device never
+            drains waiting on a metrics round trip)."""
+            self._harvest_macc(local, hist_tot, nsteps[0], final)
+            nsteps[0] = 0
 
         def dispatch(views_list):
             while len(views_list) < D:
                 views_list.append(pad_block())
             blocks = {k: np.stack([v[k] for v in views_list])
-                      for k in ("hl", "rd", "labels")}
+                      for k in ("pw", "labels")}
             blocks["ovf_b"] = np.stack(
                 [v.get("ovf_b", ovf_pad_b) for v in views_list])
             blocks["ovf_r"] = np.stack(
                 [v.get("ovf_r", ovf_pad_r) for v in views_list])
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
-                    pending.append(
-                        self.store.tile_train_step_mesh(blocks, info))
-                    if self.reporter.due():
+                    self.store.tile_train_step_mesh(blocks, info)
+                    nsteps[0] += 1
+                    if (self.reporter.due()
+                            or nsteps[0] >= self.CREC_DRAIN_CHUNK):
                         with self.timer.scope(pfx + "wait"):
-                            drain_pending()
+                            drain_pending(final=False)
                 else:
                     m = self.store.tile_eval_step_mesh(blocks, info)
                     local.objv += float(np.asarray(m[0]))
@@ -890,35 +891,20 @@ class AsyncSGD:
 
         spec = info.spec
         oc = max(info.ovf_cap, 1)
-        pads = (np.full(spec.pairs_shape, np.uint16(0xFFFF), np.uint16),
-                np.zeros(spec.pairs_shape, np.uint16),
+        pads = (np.full(spec.pairs_shape, PADWORD, np.uint32),
                 np.full(info.block_rows, 255, np.uint8),
                 np.full(oc, 0xFFFFFFFF, np.uint32),
                 np.zeros(oc, np.uint32))
 
         def pad_block():
-            return {"hl": pads[0], "rd": pads[1], "labels": pads[2],
-                    "ovf_b": pads[3], "ovf_r": pads[4]}
+            return {"pw": pads[0], "labels": pads[1],
+                    "ovf_b": pads[2], "ovf_r": pads[3]}
 
-        pending: list = []   # train metric vectors awaiting one stacked D2H
+        nsteps = [0]   # train steps since the last accumulator fetch
 
-        def drain_pending() -> None:
-            if not pending:
-                return
-            import jax.numpy as jnp
-            rows = jax.device_get(jnp.stack(pending))
-            for row in rows:
-                local.objv += float(row[0])
-                local.num_ex += int(row[1])
-                local.count += 1
-                local.acc += float(row[2])
-                local.wdelta2 += float(row[3])
-                bins = (len(row) - 4) // 2
-                hist_tot[0] += row[4:4 + bins]
-                hist_tot[1] += row[4 + bins:]
-            local.auc = auc_from_hist(*hist_tot) * local.count
-            pending.clear()
-            self._display(local)
+        def drain_pending(final: bool = True) -> None:
+            self._harvest_macc(local, hist_tot, nsteps[0], final)
+            nsteps[0] = 0
 
         def collect(group):
             nonlocal my_it, finished_id
@@ -962,19 +948,20 @@ class AsyncSGD:
                 continue
             while len(group) < dlocal:
                 group.append(pad_block())
-            blocks = {k: np.stack([v.get(k, pads[3] if k == "ovf_b"
-                                         else pads[4])
+            blocks = {k: np.stack([v.get(k, pads[2] if k == "ovf_b"
+                                         else pads[3])
                                    for v in group])
-                      for k in ("hl", "rd", "labels", "ovf_b", "ovf_r")}
+                      for k in ("pw", "labels", "ovf_b", "ovf_r")}
             gblocks = multihost_utils.host_local_array_to_global_array(
                 blocks, self.rt.mesh, P(DATA_AXIS))
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
-                    pending.append(
-                        self.store.tile_train_step_mesh(gblocks, info))
-                    if self.reporter.due():
+                    self.store.tile_train_step_mesh(gblocks, info)
+                    nsteps[0] += 1
+                    if (self.reporter.due()
+                            or nsteps[0] >= self.CREC_DRAIN_CHUNK):
                         with self.timer.scope(pfx + "wait"):
-                            drain_pending()
+                            drain_pending(final=False)
                 else:
                     m = self.store.tile_eval_step_mesh(gblocks, info)
                     local.objv += float(np.asarray(m[0]))

@@ -352,19 +352,25 @@ class ShardedStore(TableCheckpoint):
             labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
             ovf_b = block["ovf_b"] if oc else None
             ovf_r = block["ovf_r"] if oc else None
-            return block["hl"], block["rd"], labels, row_mask, ovf_b, ovf_r
+            return block["pw"], labels, row_mask, ovf_b, ovf_r
 
         if kind == "train":
-            @partial(jax.jit, donate_argnums=(0, 2))
-            def step(slots, block, t, tau):
-                hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
+            # per-step metrics ADD into a donated on-device accumulator:
+            # the step returns no host-visible value at all, so the
+            # steady-state loop fetches ONE (4+2*bins,) buffer per display
+            # window instead of stacking per-step vectors (the stack +
+            # device_get measured 1.8 ms/step through a tunneled
+            # transport; round-3 e2etrace)
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                pw, labels, row_mask, ovf_b, ovf_r = decode(block)
                 s32 = slots.astype(jnp.float32)
                 w = handle.weights(s32)
-                margin = tilemm.forward_margins(hl, rd, w, spec,
+                margin = tilemm.forward_margins(pw, w, spec,
                                                 ovf_b, ovf_r)
                 objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
-                grad = tilemm.backward_grad(hl, rd, dual, spec,
+                grad = tilemm.backward_grad(pw, dual, spec,
                                             ovf_b, ovf_r)
                 new = handle.push(s32, grad, t.astype(jnp.float32),
                                   tau)
@@ -372,20 +378,16 @@ class ShardedStore(TableCheckpoint):
                 acc = accuracy(labels, margin, row_mask)
                 pos, neg = margin_hist(labels, margin, row_mask)
                 d0 = new[:, 0] - s32[:, 0]
-                # ONE packed metrics buffer per step: the harvest loop
-                # stacks pending blocks' metrics and fetches a single
-                # device buffer — per-leaf fetches are one host round
-                # trip each, which dominates on a tunneled transport
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new.astype(slots.dtype), t + 1, packed
+                return new.astype(slots.dtype), t + 1, macc + packed
         else:
             @jax.jit
             def step(slots, block):
-                hl, rd, labels, row_mask, ovf_b, ovf_r = decode(block)
+                pw, labels, row_mask, ovf_b, ovf_r = decode(block)
                 w = handle.weights(slots.astype(jnp.float32))
-                margin = tilemm.forward_margins(hl, rd, w, spec,
+                margin = tilemm.forward_margins(pw, w, spec,
                                                 ovf_b, ovf_r)
                 objv = objv_fn(margin, labels, row_mask)
                 num_ex = jnp.sum(row_mask)
@@ -433,15 +435,14 @@ class ShardedStore(TableCheckpoint):
         oc, R = info.ovf_cap, info.block_rows
         have_model = m > 1 and MODEL_AXIS in mesh.axis_names
 
-        def body(slots_l, hl_l, rd_l, lab_l, ovb_l, ovr_l, t, tau):
-            hl1 = hl_l[0].reshape(spec_local.pairs_shape)
-            rd1 = rd_l[0].reshape(spec_local.pairs_shape)
+        def body(slots_l, pw_l, lab_l, ovb_l, ovr_l, t, tau, macc):
+            pw1 = pw_l[0].reshape(spec_local.pairs_shape)
             lab = lab_l[0]
             row_mask = (lab != jnp.uint8(255)).astype(jnp.float32)
             labels = jnp.minimum(lab, 1).astype(jnp.float32)
             s32 = slots_l.astype(jnp.float32)
             w = handle.weights(s32)
-            mg = tilemm.forward_margins(hl1, rd1, w, spec_local)
+            mg = tilemm.forward_margins(pw1, w, spec_local)
             off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
                    if have_model else 0)
             if oc:
@@ -464,7 +465,7 @@ class ShardedStore(TableCheckpoint):
                 neg = jax.lax.psum(neg, DATA_AXIS)
                 return (mets[0], mets[1], mets[2], pos, neg, margin)
             dual = dual_fn(margin, labels, row_mask)
-            g = tilemm.backward_grad(hl1, rd1, dual, spec_local)
+            g = tilemm.backward_grad(pw1, dual, spec_local)
             if oc:
                 dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
                 g = g.at[idx].add(dv)
@@ -481,15 +482,15 @@ class ShardedStore(TableCheckpoint):
                            wdelta2]),
                 jax.lax.psum(pos, DATA_AXIS),
                 jax.lax.psum(neg, DATA_AXIS)])
-            return new.astype(slots_l.dtype), t + 1, packed
+            return new.astype(slots_l.dtype), t + 1, macc + packed
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
         Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
                 else P(DATA_AXIS, None, None, None))
-        data_specs = (Pm, Pblk, Pblk, P(DATA_AXIS, None),
+        data_specs = (Pm, Pblk, P(DATA_AXIS, None),
                       P(DATA_AXIS, None), P(DATA_AXIS, None))
         if kind == "train":
-            in_specs = data_specs + (P(), P())
+            in_specs = data_specs + (P(), P(), P())
             out_specs = (Pm, P(), P())
             fn = body
         else:
@@ -497,16 +498,18 @@ class ShardedStore(TableCheckpoint):
             in_specs = data_specs
             out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
 
-            def fn(s, hl_, rd_, lab_, ovb_, ovr_):
-                return body(s, hl_, rd_, lab_, ovb_, ovr_,
-                            jnp.float32(0), jnp.float32(0))
+            def fn(s, pw_, lab_, ovb_, ovr_):
+                # body's eval branch returns before touching t/tau/macc
+                return body(s, pw_, lab_, ovb_, ovr_,
+                            jnp.float32(0), jnp.float32(0),
+                            jnp.float32(0))
         step = jax.jit(
             shard_map(fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False),
-            # donate slots/clock only when the step returns them (train);
-            # the eval step has no aliasable output, so donating would
-            # leave self.slots pointing at a donated buffer
-            donate_argnums=(0, 6) if kind == "train" else ())
+            # donate slots/clock/accumulator only when the step returns
+            # them (train); the eval step has no aliasable output, so
+            # donating would leave self.slots at a donated buffer
+            donate_argnums=(0, 5, 7) if kind == "train" else ())
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}
         self._tile_cache[key] = step
@@ -514,36 +517,70 @@ class ShardedStore(TableCheckpoint):
 
     def tile_train_step_mesh(self, blocks: dict, info, tau: float = 0.0):
         """Mesh tile step over ``data_axis_size`` blocks stacked on a
-        leading axis: blocks = {hl (D,T,SG,N), rd same, labels (D,R),
-        ovf_b (D,O), ovf_r (D,O)}."""
+        leading axis: blocks = {pw (D,T,SG,N), labels (D,R),
+        ovf_b (D,O), ovf_r (D,O)}. Metrics accumulate on device
+        (fetch_metrics), cross-shard sums included; returns the step
+        clock scalar."""
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
         z = np.zeros((D, max(oc, 1)), np.uint32)
-        self.slots, t_new, metrics = step(
-            self.slots, blocks["hl"], blocks["rd"], blocks["labels"],
+        self.slots, t_new, self._macc = step(
+            self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
-            self._t_device(), self._tau_const(tau))
+            self._t_device(), self._tau_const(tau), self._macc_buf())
         self._advance_t(t_new)
-        return metrics
+        return t_new
 
     def tile_eval_step_mesh(self, blocks: dict, info):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         z = np.zeros((D, max(oc, 1)), np.uint32)
         return self._tile_step_mesh(info, "eval")(
-            self.slots, blocks["hl"], blocks["rd"], blocks["labels"],
+            self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z))
+
+    # packed metric layout: [objv, num_ex, acc, wdelta2, pos[512], neg[512]]
+    MACC_LEN = 4 + 2 * 512
+
+    def _macc_buf(self):
+        if getattr(self, "_macc", None) is None:
+            self._macc = jnp.zeros(self.MACC_LEN, jnp.float32)
+        return self._macc
+
+    def fetch_metrics_async(self):
+        """Reset the on-device metric accumulator and start a NON-blocking
+        device->host copy of its final value; ``np.asarray(ticket)``
+        resolves it. The returned buffer is never donated again (the next
+        step starts a fresh accumulator), so reading it later is safe —
+        and the device pipeline never drains waiting on a metrics round
+        trip (a blocking fetch measured ~97 ms of idle per window through
+        a tunneled transport; round-3 e2etrace)."""
+        if getattr(self, "_macc", None) is None:
+            return np.zeros(self.MACC_LEN, np.float32)
+        buf = self._macc
+        self._macc = None
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:
+            pass
+        return buf
+
+    def fetch_metrics(self) -> np.ndarray:
+        """Blocking fetch-and-reset of the metric accumulator."""
+        return np.asarray(self.fetch_metrics_async())
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block step over a typed block dict (crec.block2_views
-        shipped to device); returns (objv, num_ex, acc, pos_hist, neg_hist,
-        wdelta2) — AUC comes from the merged histograms."""
+        shipped to device). Metrics accumulate ON DEVICE (fetch_metrics);
+        the returned device scalar (the step clock) exists only so callers
+        can gate the staleness window on real completion."""
         step = self._tile_step(info, "train")
-        self.slots, t_new, metrics = step(
-            self.slots, block, self._t_device(), self._tau_const(tau))
+        self.slots, t_new, self._macc = step(
+            self.slots, block, self._t_device(), self._tau_const(tau),
+            self._macc_buf())
         self._advance_t(t_new)
-        return metrics
+        return t_new
 
     def tile_eval_step(self, block: dict, info):
         return self._tile_step(info, "eval")(self.slots, block)

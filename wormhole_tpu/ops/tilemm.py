@@ -12,20 +12,34 @@ sparse compute so BOTH directions run on the MXU as dense one-hot matmuls:
     (hi 128) x (lo 128). Offline (the crec2 writer, data/crec.py), each
     block's (bucket, row) pairs are grouped by tile and digit-encoded.
   * Pull (w per pair):   m = OH(hi) @ W_tile;  w_p = m[p, lo_p] via a
-    one-hot lane pick. A gather became a (N,128)@(128,128) matmul.
+    one-hot lane pick. A gather became a (C,128)@(128,128) matmul.
   * Row reduce (margin): rows factor as (rhi 128) x (rlo 64); the margin
     grid is the joint histogram  OH(rhi)^T @ (w_p * OH(rlo))  — a matmul
     whose (128,64) output IS the per-row margins, reshaped.
   * Push (grad histogram): G_tile = OH(hi)^T @ (dual_p * OH(lo)) — the
-    4M-bin scatter-add became a (128,N)@(N,128) matmul per tile.
+    4M-bin scatter-add became a (128,C)@(C,128) matmul per tile.
 
-Cost is pairs x tile_size x 2 flops — independent of nb — ~150 GFLOP per
-100K-row criteo block, ~1-2ms of MXU instead of ~77ms of serialized
-scatter (round-2 BENCH). Padding pairs carry hi digit 0x1FF: their
-one-hot row is all-zero, so they vanish from every product — no masks.
+Cost is pairs x tile_size x 2 flops — independent of nb — ~600 GFLOP per
+100K-row criteo block of MXU instead of ~77ms of serialized scatter
+(round-2 BENCH). The kernels are VPU/relayout-sensitive, not just
+MXU-bound; two layout rules brought them from 21% to >50% of the
+MXU-pass floor (measured round 3, scripts/ktune.py):
 
-Encoded pair = two u16s:  hi_lo = hi<<7 | lo   (pad = 0xFFFF)
-                          rowd  = row-in-subblock (13 bits)
+  1. every dot is a plain A@B (contract lanes of lhs with sublanes of
+     rhs) — the "transposed" one-hots (rhiT, ohhiT) are BUILT in that
+     orientation (digit on sublanes, pair index on lanes), so Mosaic
+     inserts no transposes and the digit vector needs no relayout there;
+  2. all four digits of a pair are packed into ONE u32 word, so the
+     value-chain one-hots (pair index on sublanes) need a single
+     lanes->sublanes relayout of the packed word per subblock instead of
+     one per one-hot.
+
+Pair word fields: lo = bits 0..6, hi = bits 7..15 (9 bits so the pad
+value 511 is representable), rlo = bits 16..21, rhi = bits 22..28.
+Pad word = 511 << 7: its hi digit matches no iota in [0,128), so the
+pad row/column of every hi one-hot is all-zero — and the hi one-hot
+guards both directions (fwd: m row = 0 kills the value chain; bwd: the
+ohhiT column = 0 kills the contribution). No masks needed.
 
 Skewed data (a bucket hit by more than `cap` pairs of one subblock, e.g.
 a criteo missing-value token) overflows to a small (bucket, row) COO list
@@ -54,7 +68,11 @@ TILE = A_HI * B_LO  # buckets per tile
 RH = 128            # row hi digit
 RL = 64             # row lo digit
 RSUB = RH * RL      # rows per subblock (8192)
-PAD16 = np.uint16(0xFFFF)
+
+# packed pair word (u32): lo | hi<<7 | rlo<<16 | rhi<<22
+LO_SH, HI_SH, RLO_SH, RHI_SH = 0, 7, 16, 22
+LO_M, HI_M, RLO_M, RHI_M = 127, 511, 63, 127
+PADWORD = np.uint32(511 << HI_SH)
 
 
 def _interpret() -> bool:
@@ -68,7 +86,7 @@ class TileSpec:
     nb: int              # model buckets; multiple of TILE
     subblocks: int       # S: rows per block = S * 8192
     cap: int             # C: max pairs per (subblock, tile); mult of 128
-    group: int = 4       # GS: subblocks batched per inner matmul
+    group: int = 4       # GS: subblocks batched per pairs-array slice
     tiles_step: int = 4  # TB: tiles per pallas grid step
 
     def __post_init__(self):
@@ -91,7 +109,7 @@ class TileSpec:
         return self.subblocks * RSUB
 
     @property
-    def n(self) -> int:  # pairs per inner group
+    def n(self) -> int:  # pairs per grouped slice
         return self.group * self.cap
 
     @property
@@ -100,12 +118,13 @@ class TileSpec:
 
 
 def make_spec(nb: int, subblocks: int, cap: int) -> TileSpec:
-    """TileSpec with the largest group/tiles_step (<=4, the measured sweet
-    spot) that divide the given shape — small files get degenerate but
-    valid batching."""
+    """TileSpec with the largest group (<=4) and tiles_step (<=16, the
+    measured sweet spot: amortizes grid overhead, still compiles fast)
+    that divide the given shape — small files get degenerate but valid
+    batching."""
     group = max(g for g in (4, 2, 1) if subblocks % g == 0)
     tiles = nb // TILE
-    tb = max(t for t in (4, 2, 1) if tiles % t == 0)
+    tb = max(t for t in (16, 8, 4, 2, 1) if tiles % t == 0)
     return TileSpec(nb=nb, subblocks=subblocks, cap=cap, group=group,
                     tiles_step=tb)
 
@@ -114,62 +133,75 @@ def make_spec(nb: int, subblocks: int, cap: int) -> TileSpec:
 # offline encoder (host, numpy) — used by the crec2 writer and tests
 # ---------------------------------------------------------------------------
 
+def pack_fields(bucket_in_tile: np.ndarray, row_in_sub: np.ndarray
+                ) -> np.ndarray:
+    """Digit-encode (bucket % TILE, row % RSUB) into packed u32 words."""
+    b = bucket_in_tile.astype(np.uint32)
+    r = row_in_sub.astype(np.uint32)
+    return ((b & 127) | ((b >> 7) << HI_SH)
+            | ((r & 63) << RLO_SH) | ((r >> 6) << RHI_SH))
+
+
+def unpack_fields(pw: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+    """(bucket_in_tile, row_in_sub, is_pad) from packed words."""
+    pw = pw.astype(np.uint32)
+    hi = (pw >> HI_SH) & HI_M
+    b = (hi << 7) | (pw & LO_M)
+    r = (((pw >> RHI_SH) & RHI_M) << 6) | ((pw >> RLO_SH) & RLO_M)
+    return b, r, hi >= 128
+
+
 def encode_subblock(buckets: np.ndarray, rows: np.ndarray,
                     spec: TileSpec) -> Tuple[np.ndarray, np.ndarray,
-                                             np.ndarray, np.ndarray]:
+                                             np.ndarray]:
     """Group one subblock's pairs by tile.
 
     buckets int64 (P,) in [0, nb); rows (P,) in [0, 8192).
-    Returns (hi_lo u16 (T, cap), rowd u16 (T, cap), ovf_buckets, ovf_rows);
+    Returns (pw u32 (T, cap), ovf_buckets, ovf_rows);
     overflow = pairs beyond `cap` in their tile (exact COO spill).
     """
     T, C = spec.tiles, spec.cap
     tile = buckets >> 14
-    hi_lo = ((buckets & 16383).astype(np.uint16))       # hi<<7|lo == b%16384
     order = np.argsort(tile, kind="stable")
     tile_s = tile[order]
     counts = np.bincount(tile_s, minlength=T)
     starts = np.zeros(T + 1, np.int64)
     np.cumsum(counts, out=starts[1:])
-    out_hl = np.full((T, C), PAD16, np.uint16)
-    out_rd = np.zeros((T, C), np.uint16)
-    hl_s = hi_lo[order]
-    rd_s = rows.astype(np.uint16)[order]
+    out = np.full((T, C), PADWORD, np.uint32)
+    pw_s = pack_fields(buckets & 16383, rows)[order]
     # vectorized ragged copy: positions of kept pairs in the sorted stream
     idx = np.arange(len(tile_s)) - starts[tile_s]
     keep = idx < C
-    out_hl[tile_s[keep], idx[keep]] = hl_s[keep]
-    out_rd[tile_s[keep], idx[keep]] = rd_s[keep]
+    out[tile_s[keep], idx[keep]] = pw_s[keep]
     spill = ~keep
-    return (out_hl, out_rd,
+    return (out,
             buckets[order][spill].astype(np.uint32),
             rows[order][spill].astype(np.uint32))
 
 
 def encode_block(buckets: np.ndarray, rows: np.ndarray,
                  spec: TileSpec) -> Tuple[np.ndarray, np.ndarray,
-                                          np.ndarray, np.ndarray]:
+                                          np.ndarray]:
     """Encode a whole block of valid (bucket, global-row) pairs.
 
-    rows in [0, block_rows). Returns (hi_lo (T, S//GS, N), rowd same,
+    rows in [0, block_rows). Returns (pw (T, S//GS, N) u32,
     ovf_buckets u32, ovf_rows u32 (block-global rows))."""
     S, T, C = spec.subblocks, spec.tiles, spec.cap
-    hl = np.empty((S, T, C), np.uint16)
-    rd = np.empty((S, T, C), np.uint16)
+    pw = np.empty((S, T, C), np.uint32)
     ovb: List[np.ndarray] = []
     ovr: List[np.ndarray] = []
     sub = rows // RSUB
     for s in range(S):
         m = sub == s
-        h, r, ob, orow = encode_subblock(buckets[m], rows[m] % RSUB, spec)
-        hl[s], rd[s] = h, r
+        p, ob, orow = encode_subblock(buckets[m], rows[m] % RSUB, spec)
+        pw[s] = p
         if len(ob):
             ovb.append(ob)
             ovr.append(orow + s * RSUB)
     # (S,T,C) -> (T,S,C) -> group-flattened kernel layout
-    hl = np.swapaxes(hl, 0, 1).reshape(spec.pairs_shape)
-    rd = np.swapaxes(rd, 0, 1).reshape(spec.pairs_shape)
-    return (hl, rd,
+    pw = np.swapaxes(pw, 0, 1).reshape(spec.pairs_shape)
+    return (pw,
             np.concatenate(ovb) if ovb else np.zeros(0, np.uint32),
             np.concatenate(ovr) if ovr else np.zeros(0, np.uint32))
 
@@ -178,75 +210,77 @@ def encode_block(buckets: np.ndarray, rows: np.ndarray,
 # pallas kernels
 # ---------------------------------------------------------------------------
 
-def _iota16(n: int, width: int) -> jax.Array:
-    """(n, width) i32 lane iota, hoisted so every one-hot reuses it."""
-    return jax.lax.broadcasted_iota(jnp.int32, (n, width), 1)
+def _oh_rep(rep: jax.Array, shift: int, mask: int, n: int,
+            width: int) -> jax.Array:
+    """(n, width) bf16 one-hot of a digit of the sublane-replicated packed
+    word (32-bit compare + i1->bf16 convert; v5e has no 16-bit compares)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1)
+    return (((rep >> shift) & mask) == iota).astype(jnp.bfloat16)
 
 
-def _oh(x32: jax.Array, iota32: jax.Array) -> jax.Array:
-    """bf16 one-hot of an i32 digit vector (32-bit compare + i1->bf16
-    convert; v5e has no 16-bit compares, and astype avoids the 16-bit
-    mask relayout a select would need)."""
-    return (x32[:, None] == iota32).astype(jnp.bfloat16)
+def _ohT_vec(vec: jax.Array, shift: int, mask: int, width: int,
+             n: int) -> jax.Array:
+    """(width, n) bf16 one-hot of a digit; the word vector stays on lanes
+    (no relayout) — the orientation the histogram lhs consumes."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (width, n), 0)
+    return ((((vec >> shift) & mask)[None, :]) == iota).astype(jnp.bfloat16)
 
 
-def _fwd_kernel(spec: TileSpec, hl_ref, rd_ref, w_ref, mg_ref):
+def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref):
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _():
         mg_ref[:] = jnp.zeros_like(mg_ref)
 
-    S, GS, N = spec.subblocks, spec.group, spec.n
-    it128, it64 = _iota16(N, 128), _iota16(N, 64)
-    for tb in range(spec.tiles_step):
-        wt = w_ref[tb]                                     # (128,128) bf16
-        for g in range(S // GS):
-            hl = hl_ref[tb, g].astype(jnp.int32)
-            rd = rd_ref[tb, g].astype(jnp.int32)
-            ohhi = _oh(hl >> 7, it128)                     # pad -> 0 row
-            m = jnp.dot(ohhi, wt, preferred_element_type=jnp.float32)
-            ohlo = _oh(hl & 127, it128)
-            # lane pick + broadcast via ones-matmul: (m*ohlo) @ 1s ==
-            # w_p replicated across RL lanes — the MXU does the cross-lane
-            # reduction (VPU cross-lane sums are relayout-heavy)
-            wp64 = jnp.dot(m.astype(jnp.bfloat16) * ohlo,
-                           jnp.ones((B_LO, RL), jnp.bfloat16),
-                           preferred_element_type=jnp.float32)
-            ohrhi = _oh(rd >> 6, it128).reshape(GS, spec.cap, RH)
-            ohrlo = _oh(rd & 63, it64)
-            rhs = (wp64.astype(jnp.bfloat16) * ohrlo).reshape(
-                GS, spec.cap, RL)
-            mg = jax.lax.dot_general(
-                ohrhi, rhs, (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)        # (GS,RH,RL)
-            mg_ref[g * GS:(g + 1) * GS] += mg
+    S, GS, C = spec.subblocks, spec.group, spec.cap
+    ones_pick = jnp.ones((B_LO, RL), jnp.bfloat16)
+    for g in range(S // GS):
+        for j in range(GS):
+            s = g * GS + j
+            mg = mg_ref[s]
+            for tb in range(spec.tiles_step):
+                wt = w_ref[tb]                             # (128,128) bf16
+                pc = pw_ref[tb, g, j * C:(j + 1) * C].astype(jnp.int32)
+                rep = pc[:, None]                          # one relayout
+                ohhi = _oh_rep(rep, HI_SH, HI_M, C, 128)   # pad -> 0 row
+                m = jnp.dot(ohhi, wt,
+                            preferred_element_type=jnp.float32)
+                ohlo = _oh_rep(rep, LO_SH, LO_M, C, 128)
+                # lane pick + broadcast via ones-matmul: (m*ohlo) @ 1s ==
+                # w_p replicated across RL lanes — the MXU does the
+                # cross-lane reduction (VPU cross-lane sums relayout)
+                wp = jnp.dot(m.astype(jnp.bfloat16) * ohlo, ones_pick,
+                             preferred_element_type=jnp.float32)
+                ohrlo = _oh_rep(rep, RLO_SH, RLO_M, C, RL)
+                rhs = wp.astype(jnp.bfloat16) * ohrlo      # (C, RL)
+                rhiT = _ohT_vec(pc, RHI_SH, RHI_M, RH, C)
+                mg += jnp.dot(rhiT, rhs,
+                              preferred_element_type=jnp.float32)
+            mg_ref[s] = mg
 
 
-def _bwd_kernel(spec: TileSpec, hl_ref, rd_ref, dual_ref, g_ref):
-    S, GS, N = spec.subblocks, spec.group, spec.n
-    it128, it64 = _iota16(N, 128), _iota16(N, 64)
+def _bwd_kernel(spec: TileSpec, pw_ref, dual_ref, g_ref):
+    S, GS, C = spec.subblocks, spec.group, spec.cap
+    ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
     for tb in range(spec.tiles_step):
         acc = jnp.zeros((A_HI, B_LO), jnp.float32)
         for g in range(S // GS):
-            hl = hl_ref[tb, g].astype(jnp.int32)
-            rd = rd_ref[tb, g].astype(jnp.int32)
-            ohrhi = _oh(rd >> 6, it128).reshape(GS, spec.cap, RH)
-            md = jax.lax.dot_general(
-                ohrhi, dual_ref[g * GS:(g + 1) * GS],
-                (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)        # (GS,C,RL)
-            ohrlo = _oh(rd & 63, it64)
-            # pick + broadcast via ones-matmul (see fwd kernel)
-            dp128 = jnp.dot(md.reshape(N, RL).astype(jnp.bfloat16) * ohrlo,
-                            jnp.ones((RL, B_LO), jnp.bfloat16),
-                            preferred_element_type=jnp.float32)
-            ohhi = _oh(hl >> 7, it128)                     # pad -> 0 col
-            ohlo = _oh(hl & 127, it128)
-            rhs = dp128.astype(jnp.bfloat16) * ohlo
-            acc += jax.lax.dot_general(
-                ohhi, rhs, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)        # (128,128)
+            for j in range(GS):
+                s = g * GS + j
+                pc = pw_ref[tb, g, j * C:(j + 1) * C].astype(jnp.int32)
+                rep = pc[:, None]                          # one relayout
+                ohrhi = _oh_rep(rep, RHI_SH, RHI_M, C, RH)
+                md = jnp.dot(ohrhi, dual_ref[s],
+                             preferred_element_type=jnp.float32)  # (C,RL)
+                ohrlo = _oh_rep(rep, RLO_SH, RLO_M, C, RL)
+                dp = jnp.dot(md.astype(jnp.bfloat16) * ohrlo, ones_bcast,
+                             preferred_element_type=jnp.float32)  # (C,128)
+                ohlo = _oh_rep(rep, LO_SH, LO_M, C, 128)
+                rhs = dp.astype(jnp.bfloat16) * ohlo
+                ohhiT = _ohT_vec(pc, HI_SH, HI_M, A_HI, C)  # pad -> 0 col
+                acc += jnp.dot(ohhiT, rhs,
+                               preferred_element_type=jnp.float32)
         g_ref[tb] = acc
 
 
@@ -256,13 +290,12 @@ def _build_fwd(spec: TileSpec):
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
 
     @jax.jit
-    def fwd(hl, rd, w):
+    def fwd(pw, w):
         wt = w.reshape(T, A_HI, B_LO).astype(jnp.bfloat16)
         mg = pl.pallas_call(
             partial(_fwd_kernel, spec),
             grid=(T // TB,),
             in_specs=[
-                pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
                 pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
                 pl.BlockSpec((TB, A_HI, B_LO), lambda t: (t, 0, 0)),
             ],
@@ -271,7 +304,7 @@ def _build_fwd(spec: TileSpec):
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
-        )(hl, rd, wt)
+        )(pw, wt)
         return mg.reshape(spec.block_rows)
 
     return fwd
@@ -283,13 +316,12 @@ def _build_bwd(spec: TileSpec):
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
 
     @jax.jit
-    def bwd(hl, rd, dual_rows):
+    def bwd(pw, dual_rows):
         dg = dual_rows.reshape(S, RH, RL).astype(jnp.bfloat16)
         g = pl.pallas_call(
             partial(_bwd_kernel, spec),
             grid=(T // TB,),
             in_specs=[
-                pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
                 pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
                 pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
             ],
@@ -298,7 +330,7 @@ def _build_bwd(spec: TileSpec):
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
-        )(hl, rd, dg)
+        )(pw, dg)
         return g.reshape(spec.nb)
 
     return bwd
@@ -306,12 +338,12 @@ def _build_bwd(spec: TileSpec):
 
 # -- public jit-safe surface (call inside a jitted step) --------------------
 
-def forward_margins(hl: jax.Array, rd: jax.Array, w: jax.Array,
+def forward_margins(pw: jax.Array, w: jax.Array,
                     spec: TileSpec,
                     ovf_b: Optional[jax.Array] = None,
                     ovf_r: Optional[jax.Array] = None) -> jax.Array:
     """margins (block_rows,) = sum of w[bucket] over each row's pairs."""
-    margins = _build_fwd(spec)(hl, rd, w)
+    margins = _build_fwd(spec)(pw, w)
     if ovf_b is not None and ovf_b.shape[0]:
         valid = ovf_b != jnp.uint32(0xFFFFFFFF)
         wv = jnp.where(valid, w[jnp.where(valid, ovf_b, 0).astype(jnp.int32)],
@@ -321,12 +353,12 @@ def forward_margins(hl: jax.Array, rd: jax.Array, w: jax.Array,
     return margins
 
 
-def backward_grad(hl: jax.Array, rd: jax.Array, dual_rows: jax.Array,
+def backward_grad(pw: jax.Array, dual_rows: jax.Array,
                   spec: TileSpec,
                   ovf_b: Optional[jax.Array] = None,
                   ovf_r: Optional[jax.Array] = None) -> jax.Array:
     """G (nb,) = per-bucket sum of dual over the bucket's pairs."""
-    g = _build_bwd(spec)(hl, rd, dual_rows)
+    g = _build_bwd(spec)(pw, dual_rows)
     if ovf_b is not None and ovf_b.shape[0]:
         valid = ovf_b != jnp.uint32(0xFFFFFFFF)
         d = jnp.where(valid,

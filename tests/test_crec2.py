@@ -168,3 +168,47 @@ def test_crec2_mesh_training_converges(tmp_path, rng):
     prog = app.run()
     assert prog.num_ex == 6 * n
     assert prog.acc / max(prog.count, 1) > 0.85
+
+
+def test_crec2_metric_accounting_exact(tmp_path, rng):
+    """The on-device metric accumulator + async ticket pipeline credits
+    every step exactly once across mid-stream (non-final) drains, cached
+    replay windows, and the final flush: num_ex == rows x passes, count
+    == steps, and accuracy stays a mean over steps."""
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import Config
+
+    n = 2 * 4 * tilemm.RSUB + 100          # 3 blocks, padded tail
+    keys, labels = make_rows(rng, n)
+    keys[rng.random((n, NNZ)) < 0.9] = 0xFFFFFFFF   # sparse rows: small cap
+    # keep every row non-empty with a fresh uniform key (a shared
+    # constant would be exactly the hot-bucket skew the cap rejects)
+    keys[:, 0] = rng.integers(1, 1 << 32, size=n, dtype=np.uint32)
+    path = tmp_path / "acct.crec2"
+    write_file(path, keys, labels, cap=8192, ovf_cap=4096)
+    cfg = Config(train_data=str(path), data_format="crec2", num_buckets=NB,
+                 lr_eta=0.5, max_data_pass=1, disp_itv=0.0,  # drain often
+                 max_delay=2, cache_device=True)
+    app = AsyncSGD(cfg)
+    passes = 5
+    num_ex = count = 0
+    objv_sum = 0.0
+    # tiny drain window so replay passes hit the mid-stream ticket path
+    # (instance attribute: must not leak into other tests' AsyncSGDs)
+    app.CREC_DRAIN_CHUNK = 2
+    for _ in range(passes):
+        prog = app.process(str(path), 0, 1)
+        num_ex += prog.num_ex
+        count += prog.count
+        objv_sum += prog.objv
+    tail = app.flush_metrics()
+    num_ex += tail.num_ex
+    count += tail.count
+    objv_sum += tail.objv
+    assert num_ex == passes * n            # padded rows not credited
+    # one credit per dispatched step: under a data-parallel mesh the 3
+    # blocks ride in ceil(3/D) grouped steps, single-device in 3
+    D = max(app.rt.data_axis_size, 1)
+    assert count == passes * -(-3 // D)
+    assert np.isfinite(objv_sum) and objv_sum > 0
+    assert not app._crec_tickets and app._crec_count == 0

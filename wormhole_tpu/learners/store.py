@@ -195,7 +195,12 @@ class ShardedStore(TableCheckpoint):
 
         @partial(jax.jit, donate_argnums=(0, 2))
         def step(slots, batch: SparseBatch, t, tau):
-            # pull (gather); compute in f32 regardless of storage dtype
+            # pull (gather); compute in f32 regardless of storage dtype.
+            # NOTE: no indices_are_sorted/unique_indices hints here even
+            # though the Localizer emits sorted-unique keys — pad_to_batch
+            # pads uniq_keys with trailing zeros, so the padded vector is
+            # neither sorted nor unique and the hints would be XLA UB
+            # (a real bucket-0 delta could race the pad-slot zero-adds)
             rows = slots[batch.uniq_keys].astype(jnp.float32)
             w = handle.weights(rows)
             margin = spmv_times(batch.cols, batch.vals, w)

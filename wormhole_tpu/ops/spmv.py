@@ -8,9 +8,21 @@ gather-index/value arrays (see data/feed.py), so
 - ``Times``  (y = X w)  = gather ``w`` at ``cols`` + masked row reduction, and
 - ``TransTimes`` (y = Xᵀ d) = scatter-add of ``d·vals`` into the key axis,
 
-both of which XLA fuses into a handful of HBM-bandwidth-bound passes; no
-scalar loops, no dynamic shapes. The OMP thread partitioning disappears —
-the VPU lanes and the mesh sharding of the key axis take its place.
+both of which XLA fuses into a handful of passes; no scalar loops, no
+dynamic shapes. The OMP thread partitioning disappears — the VPU lanes
+and the mesh sharding of the key axis take its place.
+
+Performance boundary (measured round 3, one v5e chip, 6.4M nnz/batch):
+the gather and the scatter each lower to TPU's serialized general path
+(~45 ms per 100K-row batch, ~7 ns/element); a sort+segment_sum rewrite
+is 4x worse (the 6.4M argsort dominates). Runtime batches cannot be
+tile-grouped for the MXU one-hot formulation because the grouping itself
+costs a device sort — which is why the grouping happens OFFLINE in the
+crec2 writer (data/crec.py + ops/tilemm.py), and why crec2 is the
+throughput path (~30x this kernel). This path stays for the text
+formats (whose end-to-end is parse-bound far below 640K ex/s) and the
+embedding models (FM/wide&deep), where per-key work amortizes the
+gather.
 """
 
 from __future__ import annotations

@@ -14,9 +14,10 @@ from wormhole_tpu.data.crec import (CRecInfo, CRecWriter, PAD_LABEL,
                                     SENTINEL_KEY, iter_packed, read_header,
                                     unpack_block)
 from wormhole_tpu.data.hashing import fold_keys32, key64_to_key32, mix32_np
-from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+from wormhole_tpu.learners.handles import (AdaGradHandle,
+                                            FTRLHandle, LearnRate)
 from wormhole_tpu.learners.store import (ShardedStore, StoreConfig,
-                                         supports_dense_apply)
+                                         zero_grad_push_is_identity)
 from wormhole_tpu.ops.penalty import L1L2
 
 NB = 4096
@@ -72,7 +73,14 @@ def test_mix32_host_device_parity(rng):
     np.testing.assert_array_equal(host, dev)
 
 
-def test_dense_apply_matches_sparse_path(tmp_path, rng):
+@pytest.mark.parametrize("make_handle", [
+    lambda: FTRLHandle(penalty=L1L2(0.5, 0.1), lr=LearnRate(0.1, 1.0)),
+    # AdaGrad WITH an L1 penalty: a zero-grad push is NOT the identity
+    # (the prox shrinks), so this exercises the touched-bucket mask that
+    # makes the dense sweep equal per-key apply
+    lambda: AdaGradHandle(penalty=L1L2(0.3, 0.05), lr=LearnRate(0.1, 1.0)),
+], ids=["ftrl", "adagrad_l1"])
+def test_dense_apply_matches_sparse_path(tmp_path, rng, make_handle):
     """Same data through dense-apply and the sparse pull/push path (same
     bucket fold) → identical tables."""
     import jax.numpy as jnp
@@ -87,7 +95,7 @@ def test_dense_apply_matches_sparse_path(tmp_path, rng):
 
     mk = lambda: ShardedStore(
         StoreConfig(num_buckets=NB, loss="logit", fixed_bytes=0),
-        FTRLHandle(penalty=L1L2(0.5, 0.1), lr=LearnRate(0.1, 1.0)))
+        make_handle())
     dense, sparse = mk(), mk()
 
     loc = Localizer(num_buckets=0)
@@ -111,14 +119,16 @@ def test_dense_apply_matches_sparse_path(tmp_path, rng):
 
 
 def test_dense_apply_guard():
-    from wormhole_tpu.learners.handles import AdaGradHandle, SGDHandle
-    assert supports_dense_apply(FTRLHandle(penalty=L1L2(1.0, 1.0)))
-    assert supports_dense_apply(SGDHandle(penalty=L1L2(0.0, 0.0)))
-    assert not supports_dense_apply(AdaGradHandle(penalty=L1L2(0.5, 0.0)))
+    from wormhole_tpu.learners.handles import SGDHandle
+    # decides masking, not capability: unmasked sweep for FTRL/penalty-
+    # free, touched-bucket mask otherwise (all handles run on crec now)
+    assert zero_grad_push_is_identity(FTRLHandle(penalty=L1L2(1.0, 1.0)))
+    assert zero_grad_push_is_identity(SGDHandle(penalty=L1L2(0.0, 0.0)))
+    assert not zero_grad_push_is_identity(
+        AdaGradHandle(penalty=L1L2(0.5, 0.0)))
     store = ShardedStore(StoreConfig(num_buckets=64),
                          AdaGradHandle(penalty=L1L2(0.5, 0.0)))
-    with pytest.raises(ValueError):
-        store._dense_step(8, 4, "train")
+    store._dense_step(8, 4, "train")   # builds: masked sweep, no raise
 
 
 def test_key64_to_key32_never_sentinel(rng):
@@ -234,3 +244,24 @@ def test_text2rec_crec_conversion(tmp_path, rng):
             assert l[r] == ref_labels[got_rows]
             got_rows += 1
     assert got_rows == 50
+
+
+def test_zero_dual_nudge_keeps_saturated_rows_touching():
+    """f32 sigmoid saturation makes dual exactly 0.0 for confidently-
+    classified rows; the masked dense sweep nudges those to a signed
+    1e-30 so their buckets still count as touched (and keep getting the
+    L1 prox), while padded rows stay exactly zero."""
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.store import _nudge_zero_dual
+    from wormhole_tpu.ops.loss import logit_dual
+
+    labels = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    mask = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    margin = jnp.asarray([200.0, -200.0, 0.0, 0.1])  # exp(-200) underflows
+    dual = logit_dual(margin, labels, mask)
+    assert float(dual[0]) == 0.0 and float(dual[1]) == 0.0  # saturated
+    out = np.asarray(_nudge_zero_dual(dual, labels, mask))
+    assert out[0] == np.float32(-1e-30)      # pos row pushes negative
+    assert out[1] == np.float32(1e-30)       # neg row pushes positive
+    assert out[2] == 0.0                     # masked row stays untouched
+    assert out[3] == np.asarray(dual)[3]     # live duals unchanged

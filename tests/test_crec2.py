@@ -212,3 +212,63 @@ def test_crec2_metric_accounting_exact(tmp_path, rng):
     assert count == passes * -(-3 // D)
     assert np.isfinite(objv_sum) and objv_sum > 0
     assert not app._crec_tickets and app._crec_count == 0
+
+
+def test_crec2_adagrad_l1_learns(tmp_path, rng):
+    """The tile path with a non-identity-on-zero-grad handle (AdaGrad +
+    L1): the touched-bucket mask keeps untouched buckets frozen, so the
+    planted feature is learned instead of being prox-shrunk away every
+    sweep."""
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import Config
+
+    n = 4000
+    keys, labels = make_rows(rng, n)
+    sel = rng.random(n) < 0.5
+    keys[sel, 0] = np.uint32(123456)
+    keys[~sel, 0] = np.uint32(654321)
+    labels = sel.astype(np.uint8)
+    path = tmp_path / "ada.crec2"
+    write_file(path, keys, labels)
+    cfg = Config(train_data=str(path), data_format="crec2", num_buckets=NB,
+                 lr_eta=0.5, max_data_pass=6, disp_itv=1e12, max_delay=1)
+    cfg.algo = type(cfg.algo)("adagrad")
+    cfg.lambda_ = [0.1, 0.01]
+    app = AsyncSGD(cfg)
+    app.run()
+    prog = app.progress
+    assert prog.num_ex == 6 * n
+    assert prog.acc / max(prog.count, 1) > 0.8
+    # untouched buckets stayed exactly at init (zero): the L1 prox never
+    # swept them, and touched weights are nonzero
+    w = np.asarray(app.store.handle.weights(app.store.slots))
+    assert app.store.nnz_weight() > 0
+    assert np.count_nonzero(w) < NB  # the sweep did not touch everything
+
+
+def test_crec2_predict_task(tmp_path, rng):
+    """test_data + pred_out over crec2 (the tile eval path feeding the
+    pooled predict writer): one sigma(margin) per real row, in file
+    order, padded tail rows excluded."""
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.ops.metrics import auc_np
+    from wormhole_tpu.utils.config import Config
+
+    n = 3000
+    keys, labels = make_rows(rng, n)
+    sel = rng.random(n) < 0.5
+    keys[sel, 0] = np.uint32(123456)
+    keys[~sel, 0] = np.uint32(654321)
+    labels = sel.astype(np.uint8)
+    path = tmp_path / "p.crec2"
+    write_file(path, keys, labels)
+    pred = str(tmp_path / "preds.txt")
+    cfg = Config(train_data=str(path), test_data=str(path), pred_out=pred,
+                 data_format="crec2", num_buckets=NB, lr_eta=0.5,
+                 max_data_pass=4, disp_itv=1e12, max_delay=1)
+    app = AsyncSGD(cfg)
+    app.run()
+    probs = np.array([float(x) for x in open(pred).read().split()])
+    assert len(probs) == n                 # padded rows not predicted
+    assert ((probs >= 0) & (probs <= 1)).all()
+    assert auc_np(labels.astype(np.float64), probs) > 0.9

@@ -89,16 +89,50 @@ def mix32(h: jax.Array) -> jax.Array:
     return h ^ (h >> 16)
 
 
-def supports_dense_apply(handle: Handle) -> bool:
-    """Dense apply pushes a zero gradient into every untouched bucket, so it
-    is exact only when a zero-grad push is the identity: always true for
-    FTRL (w is a pure function of z, which g=0 leaves unchanged), and true
-    for the direct-update handles only without a penalty (the prox would
-    re-shrink w every step)."""
+def zero_grad_push_is_identity(handle: Handle) -> bool:
+    """True when a zero-gradient push leaves a slot row unchanged, so the
+    fused dense sweep needs no masking: always true for FTRL (w is a pure
+    function of z, which g=0 leaves unchanged), and true for the
+    direct-update handles without a penalty. For the remaining handles
+    (e.g. AdaGrad with L1, whose prox would re-shrink every bucket every
+    step) the dense steps keep the old slots wherever the aggregated
+    gradient is exactly zero — the touched-bucket mask. So the question
+    this answers is "mask or not", NOT whether the handle can use the
+    dense paths (they all can).
+
+    To keep "grad == 0" aligned with "no rows touched the bucket", the
+    masked steps nudge exactly-zero per-row duals to a signed 1e-30
+    (f32 sigmoid saturates to dual == 0.0 for confidently-classified
+    rows; without the nudge such rows would stop triggering their
+    buckets' L1 prox, unlike the reference's per-received-key apply,
+    sgd_server_handle.h:121-140). The residual divergence is a bucket
+    whose +-1e-30 contributions cancel exactly — far below update
+    precision."""
     from wormhole_tpu.learners.handles import FTRLHandle
     if isinstance(handle, FTRLHandle):
         return True
     return handle.penalty.lambda1 == 0.0 and handle.penalty.lambda2 == 0.0
+
+
+def _nudge_zero_dual(dual, labels, row_mask):
+    """Replace exactly-zero duals of real rows with a signed 1e-30 so
+    structural touch survives sigmoid saturation (see
+    zero_grad_push_is_identity)."""
+    eps = jnp.where(labels > 0.5, jnp.float32(-1e-30), jnp.float32(1e-30))
+    return jnp.where((dual == 0.0) & (row_mask > 0), eps, dual)
+
+
+def masked_push(handle: Handle, s32, grad, t, tau, exact_dense: bool):
+    """Full-table handle apply with the touched-bucket mask when a
+    zero-grad push is not the identity. The nudge and the mask are only
+    correct TOGETHER: every caller must have passed its dual through
+    ``_nudge_zero_dual`` before forming ``grad``, or saturated rows
+    silently stop triggering their buckets' L1 prox (the bug the pair
+    exists to prevent)."""
+    new = handle.push(s32, grad, t, tau)
+    if not exact_dense:
+        new = jnp.where((grad != 0.0)[:, None], new, s32)
+    return new
 
 
 def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
@@ -244,18 +278,17 @@ class ShardedStore(TableCheckpoint):
     # raw bytes to u32 keys, fold to buckets ON DEVICE (mix32 — the host
     # does zero key work), scatter-add the gradient into a table-sized
     # buffer, and apply the handle to the WHOLE table. Exact vs the sparse
-    # path whenever zero-grad pushes are no-ops (supports_dense_apply);
-    # sentinel keys (missing criteo slots) and padded tail rows are masked.
+    # path: handles whose zero-grad push is the identity (FTRL) sweep
+    # unmasked; the rest keep old slots where grad == 0 (the touched-
+    # bucket mask, see zero_grad_push_is_identity). Sentinel keys (missing
+    # criteo slots) and padded tail rows are masked out of the gradient.
 
     def _dense_step(self, block_rows: int, nnz: int, kind: str):
         key = (block_rows, nnz, kind)
         fn = getattr(self, "_dense_cache", {}).get(key)
         if fn is not None:
             return fn
-        if kind == "train" and not supports_dense_apply(self.handle):
-            raise ValueError(
-                "dense apply needs FTRL or a penalty-free handle "
-                "(zero-grad pushes must be identity); use the sparse path")
+        exact_dense = zero_grad_push_is_identity(self.handle)
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         nb = self.cfg.num_buckets
         R, N = block_rows, nnz
@@ -285,10 +318,13 @@ class ShardedStore(TableCheckpoint):
                                                                   packed)
                 objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
+                if not exact_dense:
+                    dual = _nudge_zero_dual(dual, labels, row_mask)
                 contrib = (dual[:, None] * vf).reshape(-1)
                 grad = jnp.zeros((nb,), jnp.float32).at[b].add(contrib)
                 s32 = slots.astype(jnp.float32)
-                new = handle.push(s32, grad, t.astype(jnp.float32), tau)
+                new = masked_push(handle, s32, grad,
+                                  t.astype(jnp.float32), tau, exact_dense)
                 num_ex = jnp.sum(row_mask)
                 a = auc(labels, margin, row_mask)
                 acc = accuracy(labels, margin, row_mask)
@@ -333,18 +369,15 @@ class ShardedStore(TableCheckpoint):
     # push both run as dense one-hot matmuls on the MXU instead of
     # serialized gather/scatter (see tilemm module docstring). Same
     # dense-apply semantics as the v1 crec path: the handle sweeps the
-    # whole table, so it needs FTRL or a penalty-free handle
-    # (supports_dense_apply).
+    # whole table, with the touched-bucket mask when a zero-grad push is
+    # not the identity (zero_grad_push_is_identity).
 
     def _tile_step(self, info, kind: str):
         key = (info, kind)
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
             return fn
-        if kind == "train" and not supports_dense_apply(self.handle):
-            raise ValueError(
-                "dense apply needs FTRL or a penalty-free handle "
-                "(zero-grad pushes must be identity); use the sparse path")
+        exact_dense = zero_grad_push_is_identity(self.handle)
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
@@ -375,10 +408,12 @@ class ShardedStore(TableCheckpoint):
                                                 ovf_b, ovf_r)
                 objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
+                if not exact_dense:
+                    dual = _nudge_zero_dual(dual, labels, row_mask)
                 grad = tilemm.backward_grad(pw, dual, spec,
                                             ovf_b, ovf_r)
-                new = handle.push(s32, grad, t.astype(jnp.float32),
-                                  tau)
+                new = masked_push(handle, s32, grad,
+                                  t.astype(jnp.float32), tau, exact_dense)
                 num_ex = jnp.sum(row_mask)
                 acc = accuracy(labels, margin, row_mask)
                 pos, neg = margin_hist(labels, margin, row_mask)
@@ -419,10 +454,7 @@ class ShardedStore(TableCheckpoint):
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
             return fn
-        if kind == "train" and not supports_dense_apply(self.handle):
-            raise ValueError(
-                "dense apply needs FTRL or a penalty-free handle "
-                "(zero-grad pushes must be identity); use the sparse path")
+        exact_dense = zero_grad_push_is_identity(self.handle)
         from jax.experimental.shard_map import shard_map
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
@@ -470,12 +502,15 @@ class ShardedStore(TableCheckpoint):
                 neg = jax.lax.psum(neg, DATA_AXIS)
                 return (mets[0], mets[1], mets[2], pos, neg, margin)
             dual = dual_fn(margin, labels, row_mask)
+            if not exact_dense:
+                dual = _nudge_zero_dual(dual, labels, row_mask)
             g = tilemm.backward_grad(pw1, dual, spec_local)
             if oc:
                 dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
                 g = g.at[idx].add(dv)
             g = jax.lax.psum(g, DATA_AXIS)
-            new = handle.push(s32, g, t.astype(jnp.float32), tau)
+            new = masked_push(handle, s32, g, t.astype(jnp.float32), tau,
+                              exact_dense)
             d0 = new[:, 0] - s32[:, 0]
             wdelta2 = jnp.sum(d0 * d0)
             if have_model:

@@ -186,7 +186,7 @@ def bench_e2e_text(path: str) -> dict:
     return {"ex_per_sec": prog.num_ex / elapsed}
 
 
-def _median_window(fn, repeats=3):
+def _median_window(fn, repeats=5):
     times = []
     for _ in range(repeats):
         times.append(fn())
@@ -260,6 +260,10 @@ def bench_device_tile(path: str) -> dict:
         return time.perf_counter() - t0
 
     run(3)  # warmup
+    # overhead-cancelled difference of MEDIANS: the shared transport's
+    # congestion bursts pollute individual windows; median-of-5 per
+    # window size keeps the estimate within a few percent of the e2e-
+    # implied step time (vs_device_step should sit just below 1)
     n = 20
     t1 = _median_window(lambda: run(n))
     t2 = _median_window(lambda: run(2 * n))

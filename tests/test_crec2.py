@@ -6,6 +6,7 @@ path (both fold keys with hashing.fold_keys32), up to the tile kernels'
 bf16 value quantization.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -272,3 +273,37 @@ def test_crec2_predict_task(tmp_path, rng):
     assert len(probs) == n                 # padded rows not predicted
     assert ((probs >= 0) & (probs <= 1)).all()
     assert auc_np(labels.astype(np.float64), probs) > 0.9
+
+
+def test_restore_drops_stale_metric_accumulator(tmp_path, rng):
+    """Checkpoint restore must not credit pre-restore steps: the
+    on-device metric accumulator is dropped with the rest of the
+    transient device state."""
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.data.crec import CRec2Info
+
+    spec_nb = 2 * tilemm.TILE
+    spec = tilemm.make_spec(spec_nb, subblocks=4, cap=1024)
+    info = CRec2Info(nnz=NNZ, block_rows=spec.block_rows,
+                     total_rows=spec.block_rows, nb=spec_nb,
+                     subblocks=4, cap=spec.cap, ovf_cap=0)
+    store = ShardedStore(StoreConfig(num_buckets=spec_nb, loss="logit"),
+                         FTRLHandle(penalty=L1L2(0.1, 0.01),
+                                    lr=LearnRate(0.5, 1.0)))
+    buckets = rng.integers(0, spec_nb, size=5000, dtype=np.int64)
+    rows = rng.integers(0, spec.block_rows, size=5000).astype(np.int64)
+    pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+    assert not len(ovb)
+    labels = (rng.random(spec.block_rows) < 0.4).astype(np.uint8)
+    block = {"pw": jnp.asarray(pw), "labels": jnp.asarray(labels)}
+    snap = jax.tree_util.tree_map(np.asarray, store.state_pytree())
+    store.tile_train_step(block, info)
+    store.restore_pytree(snap)           # rewind: the step never happened
+    row = store.fetch_metrics()
+    assert row[1] == 0.0                 # no rows credited
+    store.tile_train_step(block, info)
+    row = store.fetch_metrics()
+    assert row[1] == float(spec.block_rows)

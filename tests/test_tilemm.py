@@ -93,14 +93,18 @@ def test_pad_pairs_are_noops():
     assert np.all(np.asarray(tilemm.backward_grad(pw, dual, SPEC)) == 0)
 
 
-def test_mesh_tile_step_matches_oracle():
+@pytest.mark.parametrize("algo", ["ftrl", "adagrad_l1"])
+def test_mesh_tile_step_matches_oracle(algo):
     """The shard_map tile step on a data:2,model:2 mesh computes the same
     margins/gradient/update as the exact scatter oracle: model shards own
-    tile ranges, data shards own blocks, gradients sum across data."""
+    tile ranges, data shards own blocks, gradients sum across data.
+    The adagrad_l1 case compiles and checks the masked (touched-bucket)
+    mesh branch: zero-psum'd-grad buckets must keep their exact slots."""
     import jax
     import jax.numpy as jnp
     from wormhole_tpu.data.crec import CRec2Info
-    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.handles import (AdaGradHandle, FTRLHandle,
+                                               LearnRate)
     from wormhole_tpu.learners.store import ShardedStore, StoreConfig
     from wormhole_tpu.ops.loss import logit_dual
     from wormhole_tpu.ops.penalty import L1L2
@@ -114,7 +118,11 @@ def test_mesh_tile_step_matches_oracle():
                      subblocks=2, cap=spec.cap, ovf_cap=0)
     rt = MeshRuntime.create()
     rt.mesh = make_mesh("data:2,model:2", jax.devices()[:4])
-    handle = FTRLHandle(penalty=L1L2(0.1, 0.01), lr=LearnRate(0.5, 1.0))
+    if algo == "ftrl":
+        handle = FTRLHandle(penalty=L1L2(0.1, 0.01), lr=LearnRate(0.5, 1.0))
+    else:
+        handle = AdaGradHandle(penalty=L1L2(0.1, 0.01),
+                               lr=LearnRate(0.5, 1.0))
     store = ShardedStore(StoreConfig(num_buckets=nb, loss="logit"),
                          handle, rt)
 
@@ -147,6 +155,12 @@ def test_mesh_tile_step_matches_oracle():
     want = np.asarray(handle.push(jnp.asarray(slots0),
                                   jnp.asarray(g_tot.astype(np.float32)),
                                   jnp.float32(1), jnp.float32(0)))
+    if algo != "ftrl":
+        want = np.where((g_tot != 0.0)[:, None], want, slots0)
+        # the masked branch really froze untouched buckets
+        untouched = g_tot == 0.0
+        assert untouched.any()
+        np.testing.assert_array_equal(got[untouched], slots0[untouched])
     err = np.max(np.abs(got - want)) / (np.abs(want).max() + 1e-9)
     assert err < 2e-2, err
 

@@ -171,6 +171,7 @@ class TableCheckpoint:
             self.slots = put_like(self.slots, np.asarray(slots))
         self.t = int(state["t"])
         self._t_dev = None           # re-seed the device clock
+        self._macc = None            # drop pre-restore metric window
 
     # -- device-resident step clock -----------------------------------------
     #

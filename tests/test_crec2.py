@@ -307,3 +307,20 @@ def test_restore_drops_stale_metric_accumulator(tmp_path, rng):
     store.tile_train_step(block, info)
     row = store.fetch_metrics()
     assert row[1] == float(spec.block_rows)
+
+
+def test_cross_format_warm_start_raises(tmp_path, rng):
+    """A model saved under the text key fold (splitmix64) must refuse a
+    crec2 warm start (mix32): the two schemes bucket every feature
+    differently, so a silent load would remap the whole model."""
+    from wormhole_tpu.learners.handles import FTRLHandle
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+
+    store = ShardedStore(StoreConfig(num_buckets=64), FTRLHandle())
+    # plant one nonzero weight (slot 0) so the dump has data lines
+    store.slots = store.slots.at[3, 0].set(-1.0)
+    path = str(tmp_path / "model.txt")
+    store.save_model(path, rank=0, key_fold="splitmix64")
+    with pytest.raises(ValueError, match="key_fold"):
+        store.load_model(path, expect_key_fold="mix32")
+    store.load_model(path, expect_key_fold="splitmix64")  # same fold: OK

@@ -130,3 +130,28 @@ def test_hinge_loss_gradient():
     assert float(objv_fn(m, y, mask)) == pytest.approx(1.0)
     np.testing.assert_allclose(np.asarray(dual_fn(m, y, mask)),
                                [-1.0, 0.0, 1.0, 0.0])
+
+
+def test_margin_hist_exact_counts():
+    """The one-hot-matmul histogram (margin_hist replaced a serialized
+    scatter-add; docs/perf.md) must produce EXACT counts: 0/1 weights are
+    bf16-exact and the products accumulate in f32, so every bin equals
+    the numpy histogram below 2^24 rows. Clipping maps out-of-range
+    margins to the edge bins; masked rows contribute nothing."""
+    from wormhole_tpu.ops.metrics import margin_hist
+    rng = np.random.default_rng(0)
+    n, bins, lo, hi = 50_000, 512, -8.0, 8.0
+    margin = rng.normal(0, 6, n).astype(np.float32)   # some clip past +-8
+    labels = (rng.random(n) < 0.3).astype(np.float32)
+    mask = (rng.random(n) < 0.9).astype(np.float32)
+    pos, neg = margin_hist(jnp.asarray(labels), jnp.asarray(margin),
+                           jnp.asarray(mask), bins=bins, lo=lo, hi=hi)
+    b = (np.clip((margin - lo) / (hi - lo), 0.0, 1.0)
+         * (bins - 1)).astype(np.int64)
+    want_pos = np.zeros(bins)
+    want_neg = np.zeros(bins)
+    np.add.at(want_pos, b, (labels > 0.5) * mask)
+    np.add.at(want_neg, b, (labels <= 0.5) * mask)
+    np.testing.assert_array_equal(np.asarray(pos), want_pos)
+    np.testing.assert_array_equal(np.asarray(neg), want_neg)
+    assert float(pos.sum() + neg.sum()) == float(mask.sum())

@@ -324,3 +324,73 @@ def test_cross_format_warm_start_raises(tmp_path, rng):
     with pytest.raises(ValueError, match="key_fold"):
         store.load_model(path, expect_key_fold="mix32")
     store.load_model(path, expect_key_fold="splitmix64")  # same fold: OK
+
+
+def test_crec_v1_mesh_training_converges(tmp_path, rng):
+    """AsyncSGD over crec v1 on a data:2,model:2 mesh (the shard_map
+    dense-apply step): learns the planted feature like the single-device
+    v1 path — the distributed hole VERDICT r3 flagged."""
+    n = 4000
+    keys, labels = make_rows(rng, n)
+    sel = rng.random(n) < 0.5
+    keys[sel, 0] = np.uint32(123456)
+    keys[~sel, 0] = np.uint32(654321)
+    labels = sel.astype(np.uint8)
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import Config
+    path = tmp_path / "mesh.crec"
+    with CRecWriter(str(path), nnz=NNZ, block_rows=1024) as w:
+        w.append(keys, labels)
+    import jax
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    cfg = Config(train_data=str(path), data_format="crec", num_buckets=NB,
+                 lr_eta=0.5, max_data_pass=6, disp_itv=1e12, max_delay=1)
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:2,model:2", jax.devices()[:4])
+    app = AsyncSGD(cfg, rt)
+    prog = app.run()
+    assert prog.num_ex == 6 * n
+    assert prog.acc / max(prog.count, 1) > 0.85
+
+
+def test_crec_v1_mesh_matches_single_device(tmp_path, rng):
+    """v1 mesh dense-apply weights match the single-device v1 step on
+    identical rows (exact semantics: same fold, same handle updates —
+    only the step grouping differs)."""
+    n = 2048
+    keys, labels = make_rows(rng, n)
+    sel = rng.random(n) < 0.5
+    keys[sel, 0] = np.uint32(123456)
+    keys[~sel, 0] = np.uint32(654321)
+    labels = sel.astype(np.uint8)
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import Config
+    import jax
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    path = tmp_path / "ab.crec"
+    with CRecWriter(str(path), nnz=NNZ, block_rows=512) as w:
+        w.append(keys, labels)
+
+    def train(mesh_spec):
+        cfg = Config(train_data=str(path), data_format="crec",
+                     num_buckets=NB, lr_eta=0.5, max_data_pass=2,
+                     disp_itv=1e12, max_delay=1)
+        rt = MeshRuntime.create()
+        if mesh_spec:
+            rt.mesh = make_mesh(mesh_spec, jax.devices()[:4])
+        else:
+            rt.mesh = make_mesh("data:1", jax.devices()[:1])
+        app = AsyncSGD(cfg, rt)
+        app.run()
+        return np.asarray(app.store.handle.weights(
+            app.store.slots.astype(np.float32)))
+
+    w_single = train(None)
+    # model:4 keeps the per-step geometry identical (one block per step;
+    # D=1), so range-sharding the table must be EXACT up to f32 reorder.
+    # (data:K instead groups K blocks into one handle update — a batch-
+    # size change, covered by the convergence test above.)
+    w_mesh = train("data:1,model:4")
+    live = (np.abs(w_single) > 1e-6) | (np.abs(w_mesh) > 1e-6)
+    assert live.any()
+    assert np.allclose(w_single[live], w_mesh[live], rtol=1e-4, atol=1e-5)

@@ -101,7 +101,8 @@ class CRecWriter:
         self._buf_keys = np.empty((block_rows, nnz), np.uint32)
         self._buf_labels = np.empty(block_rows, np.uint8)
         self._fill = 0
-        self._f = open(path, "wb")
+        from wormhole_tpu.data.stream import open_stream
+        self._f = open_stream(path, "wb")
         self._f.write(_HDR.pack(MAGIC, nnz, block_rows, 0, 0))
 
     def append(self, keys: np.ndarray, labels: np.ndarray) -> None:
@@ -164,7 +165,8 @@ def iter_packed(path: str, part: int = 0, nparts: int = 1,
     if not len(blocks):
         return
     full = info.block_bytes
-    with open(path, "rb") as f:
+    from wormhole_tpu.data.stream import open_stream
+    with open_stream(path, "rb") as f:
         for i in blocks:
             rows = info.rows_in_block(i)
             nbytes = info.block_nbytes(i)
@@ -213,7 +215,7 @@ def unpack_block(packed: np.ndarray,
 # 16K-bucket tile (ops/tilemm.encode_block). The on-disk bytes are the
 # kernel operands; the device does only dense matmul work.
 #
-#     header (48 B): magic "WCREC\x03\0\0", nnz u32, block_rows u32,
+#     header (48 B): magic "WCREC\x04\0\0", nnz u32, block_rows u32,
 #                    total_rows u64, nb u32, subblocks u32, cap u32,
 #                    ovf_cap u32, reserved u64
 #     per block (fixed size, tail padded at write time):
@@ -222,7 +224,7 @@ def unpack_block(packed: np.ndarray,
 #         ovf_b  u32[ovf_cap]           (0xFFFFFFFF = unused slot)
 #         ovf_r  u32[ovf_cap]
 
-MAGIC2 = b"WCREC\x03\x00\x00"
+MAGIC2 = b"WCREC\x04\x00\x00"
 _HDR2 = struct.Struct("<8sIIQIIIIQ")
 HEADER2_SIZE = _HDR2.size
 
@@ -271,6 +273,11 @@ def read_header2(path: str) -> CRec2Info:
         raw = f.read(HEADER2_SIZE)
     magic, nnz, block_rows, total, nb, sub, cap, ovf, _ = _HDR2.unpack(raw)
     if magic != MAGIC2:
+        if magic in (b"WCREC\x02\x00\x00", b"WCREC\x03\x00\x00"):
+            raise ValueError(
+                f"{path}: crec2 v{magic[5]} file — the pair encoding "
+                "changed in v4 (packed u32 word layout / row digit split); "
+                "regenerate with tools/text2rec")
         raise ValueError(f"{path}: not a crec2 file (magic {magic!r})")
     return CRec2Info(nnz=nnz, block_rows=block_rows, total_rows=total,
                      nb=nb, subblocks=sub, cap=cap, ovf_cap=ovf)
@@ -309,7 +316,8 @@ class CRec2Writer:
                                  np.uint32)
         self._buf_labels = np.empty(self.block_rows, np.uint8)
         self._fill = 0
-        self._f = open(path, "wb")
+        from wormhole_tpu.data.stream import open_stream
+        self._f = open_stream(path, "wb")
         self._f.write(_HDR2.pack(MAGIC2, nnz, self.block_rows, 0, nb,
                                  subblocks, self.cap, ovf_cap, 0))
 
@@ -398,7 +406,8 @@ def iter_packed2(path: str, part: int = 0,
     lo = part * nb_blocks // nparts
     hi = (part + 1) * nb_blocks // nparts
     size = info.block_bytes
-    with open(path, "rb") as f:
+    from wormhole_tpu.data.stream import open_stream
+    with open_stream(path, "rb") as f:
         for i in range(lo, hi):
             f.seek(info.block_offset(i))
             buf = np.empty(size, np.uint8)

@@ -4,9 +4,10 @@
 Rebuild of dmlc-core ``Stream::Create`` and ``io::FileSystem`` (consumed by
 the reference at ``learn/linear/base/arg_parser.h:19``,
 ``learn/linear/base/workload_pool.h:46-49``). Local paths are first-class;
-S3/HDFS are pluggable via `register_filesystem` and ship as informative stubs
-(this image has no egress / no boto3), so the URI surface and part-k/n
-semantics stay identical across backends.
+``s3://`` (SigV4 over stdlib HTTP, data/s3.py) and ``hdfs://`` (WebHDFS
+REST, data/webhdfs.py) construct lazily on first use from the standard
+environment variables; `register_filesystem` overrides any scheme. The
+URI surface and part-k/n semantics are identical across backends.
 """
 
 from __future__ import annotations
@@ -63,25 +64,44 @@ class LocalFileSystem(FileSystem):
         return os.path.getsize(_strip_scheme(uri))
 
 
-class _StubFileSystem(FileSystem):
-    def __init__(self, scheme: str, hint: str) -> None:
-        self._scheme, self._hint = scheme, hint
+class _LazyFileSystem(FileSystem):
+    """Defers constructing a backend until first use, so importing the
+    data plane never pays for (or requires) remote-FS configuration."""
+
+    def __init__(self, factory: Callable[[], FileSystem]) -> None:
+        self._factory = factory
+        self._fs: FileSystem | None = None
+
+    def _real(self) -> FileSystem:
+        if self._fs is None:
+            self._fs = self._factory()
+        return self._fs
 
     def open(self, uri: str, mode: str = "rb"):
-        raise NotImplementedError(
-            f"{self._scheme}:// filesystem backend not available: {self._hint}")
+        return self._real().open(uri, mode)
 
-    list_directory = open  # type: ignore[assignment]
-    size = open  # type: ignore[assignment]
+    def list_directory(self, uri: str) -> List[FileInfo]:
+        return self._real().list_directory(uri)
+
+    def size(self, uri: str) -> int:
+        return self._real().size(uri)
+
+
+def _make_s3() -> FileSystem:
+    from wormhole_tpu.data.s3 import S3FileSystem
+    return S3FileSystem()
+
+
+def _make_hdfs() -> FileSystem:
+    from wormhole_tpu.data.webhdfs import WebHDFSFileSystem
+    return WebHDFSFileSystem()
 
 
 _REGISTRY: Dict[str, FileSystem] = {
     "": LocalFileSystem(),
     "file": LocalFileSystem(),
-    "s3": _StubFileSystem("s3", "register one via register_filesystem('s3', fs) "
-                          "backed by boto3/s3fs"),
-    "hdfs": _StubFileSystem("hdfs", "register one via register_filesystem('hdfs', fs) "
-                            "backed by pyarrow.fs.HadoopFileSystem"),
+    "s3": _LazyFileSystem(_make_s3),
+    "hdfs": _LazyFileSystem(_make_hdfs),
 }
 
 

@@ -1,0 +1,185 @@
+"""HDFS filesystem over the WebHDFS REST API (stdlib HTTP only).
+
+TPU-native rebuild of dmlc-core's libhdfs backend (wired into the
+reference at ``make/config.mk:25-27`` / ``dmlc-core/src/io/hdfs_filesys.cc``;
+consumed through the same Stream/FileSystem surface as S3 — see
+``learn/linear/base/workload_pool.h:46-49``). libhdfs drags in a JVM; the
+WebHDFS REST API covers the four operations the data plane needs (ranged
+OPEN, CREATE, LISTSTATUS, GETFILESTATUS) over plain HTTP, which suits a
+TPU host image far better.
+
+URI convention: ``hdfs://host:port/path`` where ``port`` is the NameNode's
+WebHDFS HTTP port (default 9870 when omitted). Writes follow the two-step
+redirect dance the protocol mandates: CREATE against the NameNode answers
+307 with the DataNode location, the body goes to the DataNode.
+
+``HADOOP_USER_NAME`` sets the ``user.name`` query parameter.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import os
+import urllib.parse
+from typing import Dict, List, Optional, Tuple
+
+from wormhole_tpu.data.stream import FileInfo, FileSystem
+
+DEFAULT_PORT = 9870
+
+
+def _parse_uri(uri: str) -> Tuple[str, int, str]:
+    rest = uri[len("hdfs://"):]
+    authority, _, path = rest.partition("/")
+    host, _, port = authority.partition(":")
+    if not host:
+        raise ValueError(f"bad hdfs uri {uri!r}")
+    return host, int(port) if port else DEFAULT_PORT, "/" + path
+
+
+class WebHDFSFileSystem(FileSystem):
+    def __init__(self, user: Optional[str] = None,
+                 timeout: float = 60.0) -> None:
+        self.user = user if user is not None else os.environ.get(
+            "HADOOP_USER_NAME", "")
+        self.timeout = timeout
+
+    # -- low-level request (handles the NN->DN 307 redirect) ----------
+
+    def _url(self, host: str, port: int, path: str, op: str,
+             **params: str) -> str:
+        q = {"op": op, **{k: v for k, v in params.items() if v != ""}}
+        if self.user:
+            q["user.name"] = self.user
+        enc = urllib.parse.quote(path, safe="/-_.~")
+        return (f"http://{host}:{port}/webhdfs/v1{enc}"
+                f"?{urllib.parse.urlencode(q)}")
+
+    def _request(self, method: str, url: str, body: bytes = b"",
+                 follow: int = 2) -> Tuple[int, Dict[str, str], bytes]:
+        u = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(u.hostname, u.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, u.path + (f"?{u.query}" if u.query else ""),
+                         body=body,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            resp = conn.getresponse()
+            data = resp.read()
+            headers = dict(resp.getheaders())
+        finally:
+            conn.close()
+        if resp.status in (301, 302, 307) and follow > 0:
+            loc = headers.get("Location")
+            if loc:
+                return self._request(method, loc, body, follow - 1)
+        return resp.status, headers, data
+
+    def _check(self, status: int, data: bytes, what: str) -> None:
+        if status >= 300:
+            raise IOError(f"webhdfs {what} failed: HTTP {status}: "
+                          f"{data[:300]!r}")
+
+    # -- FileSystem surface ------------------------------------------
+
+    def open(self, uri: str, mode: str = "rb"):
+        host, port, path = _parse_uri(uri)
+        if "w" in mode or "a" in mode:
+            if "a" in mode:
+                raise ValueError("hdfs:// streams do not support append")
+            raw = _HDFSWriteBuffer(self, host, port, path)
+            return raw if "b" in mode else io.TextIOWrapper(raw)
+        raw = _HDFSReadStream(self, host, port, path)
+        buf = io.BufferedReader(raw, buffer_size=8 << 20)
+        return buf if "b" in mode else io.TextIOWrapper(buf)
+
+    def list_directory(self, uri: str) -> List[FileInfo]:
+        host, port, path = _parse_uri(uri)
+        st, _, data = self._request(
+            "GET", self._url(host, port, path, "LISTSTATUS"))
+        if st == 404:
+            return []      # no such directory == empty listing
+        self._check(st, data, f"list {uri}")
+        base = uri.rstrip("/")
+        out = []
+        for fs in json.loads(data)["FileStatuses"]["FileStatus"]:
+            if fs.get("type") != "FILE":
+                continue
+            suffix = fs.get("pathSuffix", "")
+            p = f"{base}/{suffix}" if suffix else base
+            out.append(FileInfo(p, int(fs.get("length", 0))))
+        return out
+
+    def size(self, uri: str) -> int:
+        host, port, path = _parse_uri(uri)
+        st, _, data = self._request(
+            "GET", self._url(host, port, path, "GETFILESTATUS"))
+        self._check(st, data, f"stat {uri}")
+        return int(json.loads(data)["FileStatus"]["length"])
+
+
+class _HDFSReadStream(io.RawIOBase):
+    def __init__(self, fs: WebHDFSFileSystem, host: str, port: int,
+                 path: str) -> None:
+        self._fs, self._host, self._port, self._path = fs, host, port, path
+        self._pos = 0
+        self._size = fs.size(f"hdfs://{host}:{port}{path}")
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, off: int, whence: int = io.SEEK_SET) -> int:
+        base = (0 if whence == io.SEEK_SET
+                else self._pos if whence == io.SEEK_CUR else self._size)
+        self._pos = max(0, base + off)
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readinto(self, b) -> int:
+        if self._pos >= self._size or not len(b):
+            return 0
+        want = min(len(b), self._size - self._pos)
+        st, _, data = self._fs._request(
+            "GET", self._fs._url(self._host, self._port, self._path,
+                                 "OPEN", offset=str(self._pos),
+                                 length=str(want)))
+        self._fs._check(st, data, f"read {self._path}")
+        n = min(len(data), want)
+        b[:n] = data[:n]
+        self._pos += n
+        return n
+
+
+class _HDFSWriteBuffer(io.BytesIO):
+    def __init__(self, fs: WebHDFSFileSystem, host: str, port: int,
+                 path: str) -> None:
+        super().__init__()
+        self._fs, self._host, self._port, self._path = fs, host, port, path
+        self._done = False
+
+    def close(self) -> None:
+        if not self._done:
+            self._done = True
+            fs = self._fs
+            # protocol-faithful two-step: CREATE with no body against the
+            # NameNode, then the data to the DataNode it redirects to
+            url = fs._url(self._host, self._port, self._path,
+                          "CREATE", overwrite="true")
+            st, hdr, data = fs._request("PUT", url, follow=0)
+            if st in (301, 302, 307) and hdr.get("Location"):
+                st, _, data = fs._request("PUT", hdr["Location"],
+                                          body=self.getvalue(), follow=0)
+            elif st < 300:
+                # single-step server: resend with the body attached
+                st, _, data = fs._request("PUT", url,
+                                          body=self.getvalue(), follow=2)
+            fs._check(st, data, f"write {self._path}")
+        super().close()

@@ -407,9 +407,9 @@ class AsyncSGD:
                 return host            # cached item: already labels-only
             return host[lab_off:lab_off + info.block_rows].copy()
 
-        if fmt == "crec2" and self.rt.mesh.size > 1:
-            return self._process_crec2_mesh(file, part, nparts, kind,
-                                            pooled, info, local)
+        if self.rt.mesh.size > 1:
+            return self._process_crec_mesh(file, part, nparts, kind,
+                                           pooled, info, local, fmt)
         pfx = "" if kind == TRAIN else "eval_"
         feed = self._feed(file, part, nparts, fmt)
         put_before = feed.put_time
@@ -469,41 +469,52 @@ class AsyncSGD:
         self.timer.add(pfx + "put", feed.put_time - put_before)
         return local
 
-    def _process_crec2_mesh(self, file: str, part: int, nparts: int,
-                            kind: str, pooled: Optional[list],
-                            info, local: Progress) -> Progress:
-        """crec2 over a multi-device mesh: feed blocks in groups of
+    def _process_crec_mesh(self, file: str, part: int, nparts: int,
+                           kind: str, pooled: Optional[list],
+                           info, local: Progress,
+                           fmt: str = "crec2") -> Progress:
+        """crec/crec2 over a multi-device mesh: feed blocks in groups of
         ``data_axis_size`` (stacked on a leading axis; short tails pad
-        with all-PAD blocks) through the shard_map tile step — model axis
-        shards the bucket tiles, data axis shards blocks."""
+        with all-PAD blocks) through the shard_map step — crec2 runs the
+        tile step (model axis shards bucket tiles), crec v1 the mesh
+        dense-apply step (model axis range-shards the folded table); data
+        axis shards blocks either way."""
         from wormhole_tpu.data.crec import PackedFeed
         from wormhole_tpu.ops.metrics import auc_from_hist
         if jax.process_count() > 1:
-            # unreachable from run() (run_multihost handles crec2 via
-            # _multihost_pass_crec2); direct process() callers must go
+            # unreachable from run() (run_multihost handles crec/crec2
+            # via _multihost_pass_crec); direct process() callers must go
             # through the multihost pass for collective alignment
             raise RuntimeError(
-                "call run()/run_multihost for multi-process crec2 — "
+                f"call run()/run_multihost for multi-process {fmt} — "
                 "process() is single-process only")
         D = self.rt.data_axis_size
-        spec = info.spec
         pfx = "" if kind == TRAIN else "eval_"
         # no-op device_put: the mesh step jits host arrays straight onto
         # their (data, model)-sharded layout
-        feed = PackedFeed(file, part, nparts, fmt="crec2",
+        feed = PackedFeed(file, part, nparts, fmt=fmt,
                           device_put=lambda x: x)
         group: list = []
 
         # shared pad arrays — building them per dispatch would allocate
         # megabytes of throwaway uint16 per step in the hot loop
-        ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF, np.uint32)
-        ovf_pad_r = np.zeros(max(info.ovf_cap, 1), np.uint32)
-        pw_pad = np.full(spec.pairs_shape, PADWORD, np.uint32)
-        lab_pad = np.full(info.block_rows, 255, np.uint8)
+        if fmt == "crec2":
+            spec = info.spec
+            ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF,
+                                np.uint32)
+            ovf_pad_r = np.zeros(max(info.ovf_cap, 1), np.uint32)
+            pw_pad = np.full(spec.pairs_shape, PADWORD, np.uint32)
+            lab_pad = np.full(info.block_rows, 255, np.uint8)
 
-        def pad_block():
-            return {"pw": pw_pad, "labels": lab_pad,
-                    "ovf_b": ovf_pad_b, "ovf_r": ovf_pad_r}
+            def pad_block():
+                return {"pw": pw_pad, "labels": lab_pad,
+                        "ovf_b": ovf_pad_b, "ovf_r": ovf_pad_r}
+        else:
+            # one all-0xFF buffer: sentinel keys AND pad labels are 0xFF
+            v1_pad = np.full(info.block_bytes, 0xFF, np.uint8)
+
+            def pad_block():
+                return v1_pad
 
         nsteps = [0]         # train steps since the last accumulator fetch
         hist_tot = [np.zeros(512), np.zeros(512)]
@@ -518,22 +529,32 @@ class AsyncSGD:
         def dispatch(views_list):
             while len(views_list) < D:
                 views_list.append(pad_block())
-            blocks = {k: np.stack([v[k] for v in views_list])
-                      for k in ("pw", "labels")}
-            blocks["ovf_b"] = np.stack(
-                [v.get("ovf_b", ovf_pad_b) for v in views_list])
-            blocks["ovf_r"] = np.stack(
-                [v.get("ovf_r", ovf_pad_r) for v in views_list])
+            if fmt == "crec2":
+                blocks = {k: np.stack([v[k] for v in views_list])
+                          for k in ("pw", "labels")}
+                blocks["ovf_b"] = np.stack(
+                    [v.get("ovf_b", ovf_pad_b) for v in views_list])
+                blocks["ovf_r"] = np.stack(
+                    [v.get("ovf_r", ovf_pad_r) for v in views_list])
+            else:
+                blocks = np.stack(views_list)
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
-                    self.store.tile_train_step_mesh(blocks, info)
+                    if fmt == "crec2":
+                        self.store.tile_train_step_mesh(blocks, info)
+                    else:
+                        self.store.dense_train_step_mesh(
+                            blocks, info.block_rows, info.nnz)
                     nsteps[0] += 1
                     if (self.reporter.due()
                             or nsteps[0] >= self.CREC_DRAIN_CHUNK):
                         with self.timer.scope(pfx + "wait"):
                             drain_pending(final=False)
                 else:
-                    m = self.store.tile_eval_step_mesh(blocks, info)
+                    m = (self.store.tile_eval_step_mesh(blocks, info)
+                         if fmt == "crec2" else
+                         self.store.dense_eval_step_mesh(
+                             blocks, info.block_rows, info.nnz))
                     local.objv += float(np.asarray(m[0]))
                     local.num_ex += int(np.asarray(m[1]))
                     local.count += 1
@@ -542,8 +563,11 @@ class AsyncSGD:
                                                np.asarray(m[4]))
                     if pooled is not None:
                         margins = np.asarray(jax.device_get(m[5]))
+                        from wormhole_tpu.data.crec import unpack_block
                         labs = np.concatenate(
-                            [v["labels"] for v in views_list])
+                            [v["labels"] if fmt == "crec2"
+                             else unpack_block(v, info)[1]
+                             for v in views_list])
                         real = labs != 255
                         pooled.append(
                             (margins[real],
@@ -842,28 +866,33 @@ class AsyncSGD:
                 harvest(jax.block_until_ready(inflight.popleft()))
         return local
 
-    def _multihost_pass_crec2(self, pattern: str, kind: str,
-                              pooled: Optional[list] = None) -> Progress:
-        """One synchronized crec2 pass across processes: every host runs
-        the replicated pool, streams blocks of its claimed part, and the
-        hosts' stacked blocks become ONE data-axis-sharded global input to
-        the mesh tile step (model axis shards bucket tiles; a host with no
+    def _multihost_pass_crec(self, pattern: str, kind: str,
+                             pooled: Optional[list] = None) -> Progress:
+        """One synchronized crec/crec2 pass across processes: every host
+        runs the replicated pool, streams blocks of its claimed part, and
+        the hosts' stacked blocks become ONE data-axis-sharded global
+        input to the mesh step — crec2 through the tile step (model axis
+        shards bucket tiles), crec v1 through the mesh dense-apply step
+        (model axis range-shards the folded bucket table). A host with no
         block this round contributes all-PAD blocks, which vanish from
-        every product)."""
+        every product."""
         from jax.experimental import multihost_utils
         from jax.sharding import PartitionSpec as P
-        from wormhole_tpu.data.crec import PackedFeed, read_header2
+        from wormhole_tpu.data.crec import (PackedFeed, read_header,
+                                            read_header2)
         from wormhole_tpu.data.stream import list_files
         from wormhole_tpu.ops.metrics import auc_from_hist
         cfg = self.cfg
+        fmt = cfg.data_format
         world = self.rt.world
         dpa = self.rt.data_axis_size
         dlocal = dpa // world          # data-axis indices per host
         pool = WorkloadPool(straggler_factor=float("inf"))
         pool.add(pattern, cfg.num_parts_per_file, kind)
-        # headers are geometry-identical across a dataset's files (the nb
+        # headers are geometry-identical across a dataset's files (the
         # check below re-verifies per opened file)
-        info = read_header2(list_files(pattern)[0].path)
+        read_hdr = read_header2 if fmt == "crec2" else read_header
+        info = read_hdr(list_files(pattern)[0].path)
         my_it = None
         my_wl = None
         drained = False
@@ -873,32 +902,42 @@ class AsyncSGD:
         pfx = "" if kind == TRAIN else "eval_"
 
         def feed_iter(wl):
-            hdr = read_header2(wl.file)
-            same = (hdr.nb == cfg.num_buckets and hdr.spec == info.spec
-                    and hdr.block_rows == info.block_rows
-                    and hdr.nnz == info.nnz
-                    and hdr.ovf_cap == info.ovf_cap)
+            hdr = read_hdr(wl.file)
+            if fmt == "crec2":
+                same = (hdr.nb == cfg.num_buckets
+                        and hdr.spec == info.spec
+                        and hdr.block_rows == info.block_rows
+                        and hdr.nnz == info.nnz
+                        and hdr.ovf_cap == info.ovf_cap)
+            else:
+                same = (hdr.block_rows == info.block_rows
+                        and hdr.nnz == info.nnz)
             if not same:
                 raise ValueError(
-                    f"{wl.file}: crec2 geometry (nb={hdr.nb}, "
-                    f"spec={hdr.spec}, rows={hdr.block_rows}, "
-                    f"nnz={hdr.nnz}, ovf={hdr.ovf_cap}) does not match "
-                    f"the dataset's first file — multihost block shards "
-                    f"must be shape-identical across hosts")
+                    f"{wl.file}: {fmt} geometry does not match the "
+                    f"dataset's first file ({hdr} vs {info}) — multihost "
+                    "block shards must be shape-identical across hosts")
             # host arrays only; the global device_put happens at assembly
             return iter(PackedFeed(wl.file, wl.part, wl.nparts,
-                                   fmt="crec2", device_put=lambda x: x))
+                                   fmt=fmt, device_put=lambda x: x))
 
-        spec = info.spec
-        oc = max(info.ovf_cap, 1)
-        pads = (np.full(spec.pairs_shape, PADWORD, np.uint32),
-                np.full(info.block_rows, 255, np.uint8),
-                np.full(oc, 0xFFFFFFFF, np.uint32),
-                np.zeros(oc, np.uint32))
+        if fmt == "crec2":
+            spec = info.spec
+            oc = max(info.ovf_cap, 1)
+            pads = (np.full(spec.pairs_shape, PADWORD, np.uint32),
+                    np.full(info.block_rows, 255, np.uint8),
+                    np.full(oc, 0xFFFFFFFF, np.uint32),
+                    np.zeros(oc, np.uint32))
 
-        def pad_block():
-            return {"pw": pads[0], "labels": pads[1],
-                    "ovf_b": pads[2], "ovf_r": pads[3]}
+            def pad_block():
+                return {"pw": pads[0], "labels": pads[1],
+                        "ovf_b": pads[2], "ovf_r": pads[3]}
+        else:
+            # one all-0xFF buffer: sentinel keys AND pad labels are 0xFF
+            v1_pad = np.full(info.block_bytes, 0xFF, np.uint8)
+
+            def pad_block():
+                return v1_pad
 
         nsteps = [0]   # train steps since the last accumulator fetch
 
@@ -948,22 +987,32 @@ class AsyncSGD:
                 continue
             while len(group) < dlocal:
                 group.append(pad_block())
-            blocks = {k: np.stack([v.get(k, pads[2] if k == "ovf_b"
-                                         else pads[3])
-                                   for v in group])
-                      for k in ("pw", "labels", "ovf_b", "ovf_r")}
+            if fmt == "crec2":
+                blocks = {k: np.stack([v.get(k, pads[2] if k == "ovf_b"
+                                             else pads[3])
+                                       for v in group])
+                          for k in ("pw", "labels", "ovf_b", "ovf_r")}
+            else:
+                blocks = np.stack(group)
             gblocks = multihost_utils.host_local_array_to_global_array(
                 blocks, self.rt.mesh, P(DATA_AXIS))
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
-                    self.store.tile_train_step_mesh(gblocks, info)
+                    if fmt == "crec2":
+                        self.store.tile_train_step_mesh(gblocks, info)
+                    else:
+                        self.store.dense_train_step_mesh(
+                            gblocks, info.block_rows, info.nnz)
                     nsteps[0] += 1
                     if (self.reporter.due()
                             or nsteps[0] >= self.CREC_DRAIN_CHUNK):
                         with self.timer.scope(pfx + "wait"):
                             drain_pending(final=False)
                 else:
-                    m = self.store.tile_eval_step_mesh(gblocks, info)
+                    m = (self.store.tile_eval_step_mesh(gblocks, info)
+                         if fmt == "crec2" else
+                         self.store.dense_eval_step_mesh(
+                             gblocks, info.block_rows, info.nnz))
                     local.objv += float(np.asarray(m[0]))
                     local.num_ex += int(np.asarray(m[1]))
                     local.count += 1
@@ -972,7 +1021,11 @@ class AsyncSGD:
                                                np.asarray(m[4]))
                     if pooled is not None:
                         margins = self._my_shard_rows(m[5])
-                        labs = np.concatenate([v["labels"] for v in group])
+                        from wormhole_tpu.data.crec import unpack_block
+                        labs = np.concatenate(
+                            [v["labels"] if fmt == "crec2"
+                             else unpack_block(v, info)[1]
+                             for v in group])
                         real = labs != 255
                         pooled.append(
                             (margins[real],
@@ -993,17 +1046,14 @@ class AsyncSGD:
         from wormhole_tpu.parallel.collectives import allreduce_tree
         from wormhole_tpu.ops.metrics import auc_np
         cfg = self.cfg
-        crec2 = cfg.data_format == "crec2"
-        if cfg.data_format == "crec":
-            raise NotImplementedError(
-                "multi-PROCESS crec(v1) training is not wired: convert to "
-                "crec2 (tile step) or use the sparse/text formats")
-        if crec2:
+        crec = cfg.data_format in ("crec", "crec2")
+        if crec:
             if self.rt.data_axis_size % self.rt.world:
                 raise ValueError(
                     f"data axis {self.rt.data_axis_size} must be a "
-                    f"multiple of world {self.rt.world} for crec2 "
-                    "multihost (whole blocks per data index)")
+                    f"multiple of world {self.rt.world} for "
+                    f"{cfg.data_format} multihost (whole blocks per "
+                    "data index)")
         elif not (cfg.max_nnz and cfg.key_pad):
             raise ValueError("multi-host sync training needs static "
                              "max_nnz= and key_pad= config")
@@ -1033,8 +1083,8 @@ class AsyncSGD:
         last_saved = start_pass
         completed = start_pass
         for data_pass in range(start_pass, cfg.max_data_pass):
-            prog = (self._multihost_pass_crec2(cfg.train_data, TRAIN)
-                    if crec2
+            prog = (self._multihost_pass_crec(cfg.train_data, TRAIN)
+                    if crec
                     else self._multihost_pass(cfg.train_data, TRAIN))
             self.progress.merge(prog)
             self._check_divergence(prog)
@@ -1046,9 +1096,9 @@ class AsyncSGD:
                 last_saved = completed
             if cfg.val_data:
                 pooled: list = []
-                vp = (self._multihost_pass_crec2(cfg.val_data, VAL,
-                                                 pooled)
-                      if crec2
+                vp = (self._multihost_pass_crec(cfg.val_data, VAL,
+                                                pooled)
+                      if crec
                       else self._multihost_pass(cfg.val_data, VAL, pooled))
                 pass_auc = self._allreduce_pooled_auc(pooled)
                 n = max(vp.num_ex, 1)
@@ -1068,8 +1118,8 @@ class AsyncSGD:
         if cfg.test_data:
             from wormhole_tpu.sched.workload_pool import TEST
             pooled = []
-            if crec2:
-                self._multihost_pass_crec2(cfg.test_data, TEST, pooled)
+            if crec:
+                self._multihost_pass_crec(cfg.test_data, TEST, pooled)
             else:
                 self._multihost_pass(cfg.test_data, TEST, pooled)
             self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
@@ -1232,7 +1282,12 @@ class AsyncSGD:
 
     def _check_divergence(self, prog: Progress) -> None:
         """Kill switch on the *freshest* workload part (cumulative averages
-        would dilute late divergence); NaN always counts as diverged."""
+        would dilute late divergence); NaN always counts as diverged.
+
+        On cached-replay crec2 parts the deferred metric window means a
+        part's Progress can include rows credited up to ~2 windows late,
+        so detection lags by that much — delayed, never lost (totals stay
+        exact; the pass-end flush_metrics() re-checks the tail)."""
         cfg = self.cfg
         per_ex = prog.objv / max(prog.num_ex, 1)
         if np.isnan(per_ex):

@@ -362,6 +362,124 @@ class ShardedStore(TableCheckpoint):
         return self._dense_step(block_rows, nnz, "eval")(
             self.slots, packed)
 
+    # -- dense-apply over a data x model mesh -------------------------------
+    #
+    # The distributed form of the crec(v1) path, mirroring the crec2 mesh
+    # tile step's geometry: the MODEL axis range-shards the bucket table
+    # (each shard folds the block's keys and keeps only buckets in its
+    # range), the DATA axis shards whole blocks. Partial margins psum over
+    # model; gradients psum over data; the handle applies shard-locally.
+    # Same packed-metric accumulator layout as the tile mesh step, so
+    # the learner's _harvest_macc path serves both formats.
+
+    def _dense_step_mesh(self, block_rows: int, nnz: int, kind: str):
+        key = (block_rows, nnz, kind, "mesh")
+        fn = getattr(self, "_dense_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        exact_dense = zero_grad_push_is_identity(self.handle)
+        from jax import shard_map
+        from wormhole_tpu.ops.metrics import margin_hist
+        from wormhole_tpu.parallel.mesh import DATA_AXIS
+        handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
+        mesh = self.rt.mesh
+        m = self.rt.model_axis_size
+        nb = self.cfg.num_buckets
+        if nb % m:
+            raise ValueError(f"num_buckets {nb} not shardable over "
+                             f"model axis {m}")
+        nb_local = nb // m
+        have_model = m > 1 and MODEL_AXIS in mesh.axis_names
+        R, N = block_rows, nnz
+        nk = R * N * 4
+
+        def body(slots_l, packed_l, t, tau, macc):
+            packed = packed_l[0]
+            keys = jax.lax.bitcast_convert_type(
+                packed[:nk].reshape(-1, 4), jnp.uint32)
+            valid = keys != jnp.uint32(0xFFFFFFFF)
+            b = (mix32(keys) % jnp.uint32(nb)).astype(jnp.int32)
+            off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
+                   if have_model else 0)
+            inr = valid & (b >= off) & (b < off + nb_local)
+            bl = jnp.where(inr, b - off, 0)
+            lab_u8 = packed[nk:nk + R]
+            row_mask = (lab_u8 != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
+            s32 = slots_l.astype(jnp.float32)
+            w = handle.weights(s32)
+            vf = inr.astype(jnp.float32).reshape(R, N)
+            mg = jnp.sum(w[bl.reshape(R, N)] * vf, axis=1)
+            margin = (jax.lax.psum(mg, MODEL_AXIS) if have_model else mg)
+            objv = objv_fn(margin, labels, row_mask)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            tot_ex = jax.lax.psum(num_ex, DATA_AXIS)
+            acc_frac = (jax.lax.psum(acc * num_ex, DATA_AXIS)
+                        / jnp.maximum(tot_ex, 1.0))
+            if kind == "eval":
+                pos = jax.lax.psum(pos, DATA_AXIS)
+                neg = jax.lax.psum(neg, DATA_AXIS)
+                return (jax.lax.psum(objv, DATA_AXIS), tot_ex, acc_frac,
+                        pos, neg, margin)
+            dual = dual_fn(margin, labels, row_mask)
+            if not exact_dense:
+                dual = _nudge_zero_dual(dual, labels, row_mask)
+            contrib = (dual[:, None] * vf).reshape(-1)
+            grad = jnp.zeros((nb_local,), jnp.float32).at[bl].add(contrib)
+            grad = jax.lax.psum(grad, DATA_AXIS)
+            new = masked_push(handle, s32, grad, t.astype(jnp.float32),
+                              tau, exact_dense)
+            d0 = new[:, 0] - s32[:, 0]
+            wdelta2 = jnp.sum(d0 * d0)
+            if have_model:
+                wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
+            packed_m = jnp.concatenate([
+                jnp.stack([jax.lax.psum(objv, DATA_AXIS),
+                           tot_ex, acc_frac, wdelta2]),
+                jax.lax.psum(pos, DATA_AXIS),
+                jax.lax.psum(neg, DATA_AXIS)])
+            return new.astype(slots_l.dtype), t + 1, macc + packed_m
+
+        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+        if kind == "train":
+            in_specs = (Pm, P(DATA_AXIS, None), P(), P(), P())
+            out_specs = (Pm, P(), P())
+            fn = body
+        else:
+            in_specs = (Pm, P(DATA_AXIS, None))
+            out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
+
+            def fn(s, packed_l):
+                return body(s, packed_l, jnp.float32(0), jnp.float32(0),
+                            jnp.float32(0))
+        step = jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False),
+            donate_argnums=(0, 2, 4) if kind == "train" else ())
+        if not hasattr(self, "_dense_cache"):
+            self._dense_cache = {}
+        self._dense_cache[key] = step
+        return step
+
+    def dense_train_step_mesh(self, packed: jax.Array, block_rows: int,
+                              nnz: int, tau: float = 0.0):
+        """Mesh dense step over ``data_axis_size`` packed v1 blocks
+        stacked on a leading axis. Metrics accumulate on device
+        (fetch_metrics); returns the step-clock scalar."""
+        step = self._dense_step_mesh(block_rows, nnz, "train")
+        self.slots, t_new, self._macc = step(
+            self.slots, packed, self._t_device(), self._tau_const(tau),
+            self._macc_buf())
+        self._advance_t(t_new)
+        return t_new
+
+    def dense_eval_step_mesh(self, packed: jax.Array, block_rows: int,
+                             nnz: int):
+        return self._dense_step_mesh(block_rows, nnz, "eval")(
+            self.slots, packed)
+
     # -- tile-blocked MXU step: the crec2 streaming fast path ---------------
     #
     # One fused program over a tile-grouped crec2 block (data/crec.py v2 +
@@ -456,7 +574,7 @@ class ShardedStore(TableCheckpoint):
         if fn is not None:
             return fn
         exact_dense = zero_grad_push_is_identity(self.handle)
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
         from wormhole_tpu.parallel.mesh import DATA_AXIS
@@ -485,7 +603,9 @@ class ShardedStore(TableCheckpoint):
                    if have_model else 0)
             if oc:
                 ovb, ovr = ovb_l[0], ovr_l[0]
-                bi = ovb.astype(jnp.int64)
+                # int32 is enough: bucket ids < nb <= 2^26; the 0xFFFFFFFF
+                # sentinel wraps to -1, already excluded by the mask below
+                bi = ovb.astype(jnp.int32)
                 valid = ((ovb != jnp.uint32(0xFFFFFFFF))
                          & (bi >= off) & (bi < off + nb_local))
                 idx = jnp.where(valid, bi - off, 0).astype(jnp.int32)
@@ -496,12 +616,20 @@ class ShardedStore(TableCheckpoint):
             num_ex = jnp.sum(row_mask)
             acc = accuracy(labels, margin, row_mask)
             pos, neg = margin_hist(labels, margin, row_mask)
+            # acc is a per-shard *fraction*; a plain psum over DATA would
+            # sum D fractions while the harvest credits count += 1 per
+            # grouped step. Weight each shard by its row count (PAD shards
+            # contribute 0 rows) so the psum'd value is the exact fraction
+            # of the grouped step — acc/count stays a mean over steps on
+            # any mesh geometry.
+            tot_ex = jax.lax.psum(num_ex, DATA_AXIS)
+            acc_frac = (jax.lax.psum(acc * num_ex, DATA_AXIS)
+                        / jnp.maximum(tot_ex, 1.0))
             if kind == "eval":
-                mets = [objv, num_ex, acc]
-                mets = [jax.lax.psum(x, DATA_AXIS) for x in mets]
                 pos = jax.lax.psum(pos, DATA_AXIS)
                 neg = jax.lax.psum(neg, DATA_AXIS)
-                return (mets[0], mets[1], mets[2], pos, neg, margin)
+                return (jax.lax.psum(objv, DATA_AXIS), tot_ex, acc_frac,
+                        pos, neg, margin)
             dual = dual_fn(margin, labels, row_mask)
             if not exact_dense:
                 dual = _nudge_zero_dual(dual, labels, row_mask)
@@ -518,9 +646,7 @@ class ShardedStore(TableCheckpoint):
                 wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
             packed = jnp.concatenate([
                 jnp.stack([jax.lax.psum(objv, DATA_AXIS),
-                           jax.lax.psum(num_ex, DATA_AXIS),
-                           jax.lax.psum(acc, DATA_AXIS),
-                           wdelta2]),
+                           tot_ex, acc_frac, wdelta2]),
                 jax.lax.psum(pos, DATA_AXIS),
                 jax.lax.psum(neg, DATA_AXIS)])
             return new.astype(slots_l.dtype), t + 1, macc + packed
@@ -546,7 +672,7 @@ class ShardedStore(TableCheckpoint):
                             jnp.float32(0))
         step = jax.jit(
             shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False),
+                      out_specs=out_specs, check_vma=False),
             # donate slots/clock/accumulator only when the step returns
             # them (train); the eval step has no aliasable output, so
             # donating would leave self.slots at a donated buffer

@@ -13,9 +13,9 @@ sparse compute so BOTH directions run on the MXU as dense one-hot matmuls:
     block's (bucket, row) pairs are grouped by tile and digit-encoded.
   * Pull (w per pair):   m = OH(hi) @ W_tile;  w_p = m[p, lo_p] via a
     one-hot lane pick. A gather became a (C,128)@(128,128) matmul.
-  * Row reduce (margin): rows factor as (rhi 128) x (rlo 64); the margin
+  * Row reduce (margin): rows factor as (rhi 64) x (rlo 128); the margin
     grid is the joint histogram  OH(rhi)^T @ (w_p * OH(rlo))  — a matmul
-    whose (128,64) output IS the per-row margins, reshaped.
+    whose (64,128) output IS the per-row margins, reshaped.
   * Push (grad histogram): G_tile = OH(hi)^T @ (dual_p * OH(lo)) — the
     4M-bin scatter-add became a (128,C)@(C,128) matmul per tile.
 
@@ -36,7 +36,7 @@ MXU-pass floor (measured round 3, scripts/ktune.py):
      the bwd kernel — instead of one per one-hot.
 
 Pair word fields: lo = bits 0..6, hi = bits 7..15 (9 bits so the pad
-value 511 is representable), rlo = bits 16..21, rhi = bits 22..28.
+value 511 is representable), rlo = bits 16..22, rhi = bits 23..28.
 Pad word = 511 << 7: its hi digit matches no iota in [0,128), so the
 pad row/column of every hi one-hot is all-zero — and the hi one-hot
 guards both directions (fwd: m row = 0 kills the value chain; bwd: the
@@ -66,13 +66,18 @@ from jax.experimental.pallas import tpu as pltpu
 A_HI = 128          # bucket hi digit (one-hot width, MXU-native)
 B_LO = 128          # bucket lo digit
 TILE = A_HI * B_LO  # buckets per tile
-RH = 128            # row hi digit
-RL = 64             # row lo digit
+RH = 64             # row hi digit
+RL = 128            # row lo digit
 RSUB = RH * RL      # rows per subblock (8192)
 
-# packed pair word (u32): lo | hi<<7 | rlo<<16 | rhi<<22
-LO_SH, HI_SH, RLO_SH, RHI_SH = 0, 7, 16, 22
-LO_M, HI_M, RLO_M, RHI_M = 127, 511, 63, 127
+# packed pair word (u32): lo | hi<<7 | rlo<<16 | rhi<<23
+#
+# RH=64/RL=128 (not 128/64): the row-hi digit is the STREAMING dim (lhs
+# rows) of the fwd histogram matmul rhiT @ rhs — RH=64 halves its MXU
+# time — and with RL=128 every matmul in both kernels is 128 lanes wide
+# (the old RL=64 pick/hist ran half-lane). Measured round 4: fwd -17%.
+LO_SH, HI_SH, RLO_SH, RHI_SH = 0, 7, 16, 23
+LO_M, HI_M, RLO_M, RHI_M = 127, 511, 127, 63
 PADWORD = np.uint32(511 << HI_SH)
 
 
@@ -140,7 +145,7 @@ def pack_fields(bucket_in_tile: np.ndarray, row_in_sub: np.ndarray
     b = bucket_in_tile.astype(np.uint32)
     r = row_in_sub.astype(np.uint32)
     return ((b & 127) | ((b >> 7) << HI_SH)
-            | ((r & 63) << RLO_SH) | ((r >> 6) << RHI_SH))
+            | ((r & np.uint32(RL - 1)) << RLO_SH) | ((r >> 7) << RHI_SH))
 
 
 def unpack_fields(pw: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
@@ -149,7 +154,7 @@ def unpack_fields(pw: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
     pw = pw.astype(np.uint32)
     hi = (pw >> HI_SH) & HI_M
     b = (hi << 7) | (pw & LO_M)
-    r = (((pw >> RHI_SH) & RHI_M) << 6) | ((pw >> RLO_SH) & RLO_M)
+    r = (((pw >> RHI_SH) & RHI_M) << 7) | ((pw >> RLO_SH) & RLO_M)
     return b, r, hi >= 128
 
 
@@ -219,6 +224,17 @@ def _oh_rep(rep: jax.Array, shift: int, mask: int, n: int,
     return (((rep >> shift) & mask) == iota).astype(jnp.bfloat16)
 
 
+def _mask_sel(rep: jax.Array, shift: int, mask: int,
+              x: jax.Array) -> jax.Array:
+    """x masked by a digit one-hot, as ONE select: where(digit==lane, x, 0)
+    then a single f32->bf16 convert — one VPU pass fewer per site than
+    building the bf16 one-hot and multiplying (cmp/sel/astype/mul)."""
+    n, width = x.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1)
+    cond = ((rep >> shift) & mask) == iota
+    return jnp.where(cond, x, jnp.float32(0)).astype(jnp.bfloat16)
+
+
 def _ohT_vec(vec: jax.Array, shift: int, mask: int, width: int,
              n: int) -> jax.Array:
     """(width, n) bf16 one-hot of a digit; the word vector stays on lanes
@@ -251,15 +267,16 @@ def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref):
             pc = pw_ref[tb, g].astype(jnp.int32)           # (N,)
             rep = pc[:, None]                              # ONE relayout
             ohhi = _oh_rep(rep, HI_SH, HI_M, N, 128)       # pad -> 0 row
+            # (bf16 matmul accumulators would skip the astype passes and
+            # are exact for one-hot contractions, but Mosaic requires a
+            # 32-bit acc — measured round 4, not supported on this MXU)
             m = jnp.dot(ohhi, wt, preferred_element_type=jnp.float32)
-            ohlo = _oh_rep(rep, LO_SH, LO_M, N, 128)
-            # lane pick + broadcast via ones-matmul: (m*ohlo) @ 1s ==
-            # w_p replicated across RL lanes — the MXU does the
-            # cross-lane reduction (VPU cross-lane sums relayout)
-            wp = jnp.dot(m.astype(jnp.bfloat16) * ohlo, ones_pick,
+            # lane pick + broadcast via ones-matmul: (m masked to lane
+            # lo_p) @ 1s == w_p replicated across RL lanes — the MXU does
+            # the cross-lane reduction (VPU cross-lane sums relayout)
+            wp = jnp.dot(_mask_sel(rep, LO_SH, LO_M, m), ones_pick,
                          preferred_element_type=jnp.float32)
-            ohrlo = _oh_rep(rep, RLO_SH, RLO_M, N, RL)
-            rhs = wp.astype(jnp.bfloat16) * ohrlo          # (N, RL)
+            rhs = _mask_sel(rep, RLO_SH, RLO_M, wp)        # (N, RL)
             for j in range(GS):
                 rhiT = _ohT_vec(pc[j * C:(j + 1) * C],
                                 RHI_SH, RHI_M, RH, C)
@@ -269,27 +286,48 @@ def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref):
             mg_ref[g * GS + j] = mgs[j]
 
 
+BP = 2  # subblocks per bwd value chain: BP * RH = 128, one full-K pass
+
+
+def _bp(spec: TileSpec) -> int:
+    """Subblocks fused per bwd value chain (BP when the group allows)."""
+    return BP if spec.group % BP == 0 else 1
+
+
 def _bwd_kernel(spec: TileSpec, pw_ref, dual_ref, g_ref):
+    """dual_ref arrives pre-reshaped (S//bp, bp*RH, RL): the value chain
+    runs over bp=2 subblocks at once — the dual-grid pick contracts a
+    128-deep joint digit ghi = rhi + RH*(subblock parity), so every
+    matmul is full-K, 128 lanes, and 2C rows long (the same long-chain
+    layout that made fwd fast; per-subblock chains measured slower,
+    round 4). Only the grad histogram splits back per subblock (each
+    needs its own ohhiT lhs)."""
     S, GS, C = spec.subblocks, spec.group, spec.cap
+    bp = _bp(spec)
+    NC = bp * C
     ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
+    # chain-local subblock offset of each pair (static)
+    offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
+    iota_ghi = jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
     for tb in range(spec.tiles_step):
         acc = jnp.zeros((A_HI, B_LO), jnp.float32)
         for g in range(S // GS):
-            for j in range(GS):
-                s = g * GS + j
-                pc = pw_ref[tb, g, j * C:(j + 1) * C].astype(jnp.int32)
+            for h in range(GS // bp):
+                sp = (g * GS) // bp + h
+                pc = pw_ref[tb, g, h * NC:(h + 1) * NC].astype(jnp.int32)
                 rep = pc[:, None]                          # one relayout
-                ohrhi = _oh_rep(rep, RHI_SH, RHI_M, C, RH)
-                md = jnp.dot(ohrhi, dual_ref[s],
-                             preferred_element_type=jnp.float32)  # (C,RL)
-                ohrlo = _oh_rep(rep, RLO_SH, RLO_M, C, RL)
-                dp = jnp.dot(md.astype(jnp.bfloat16) * ohrlo, ones_bcast,
-                             preferred_element_type=jnp.float32)  # (C,128)
-                ohlo = _oh_rep(rep, LO_SH, LO_M, C, 128)
-                rhs = dp.astype(jnp.bfloat16) * ohlo
-                ohhiT = _ohT_vec(pc, HI_SH, HI_M, A_HI, C)  # pad -> 0 col
-                acc += jnp.dot(ohhiT, rhs,
-                               preferred_element_type=jnp.float32)
+                ohghi = ((((rep >> RHI_SH) & RHI_M) + offs)
+                         == iota_ghi).astype(jnp.bfloat16)
+                md = jnp.dot(ohghi, dual_ref[sp],
+                             preferred_element_type=jnp.float32)
+                dp = jnp.dot(_mask_sel(rep, RLO_SH, RLO_M, md), ones_bcast,
+                             preferred_element_type=jnp.float32)
+                rhs = _mask_sel(rep, LO_SH, LO_M, dp)      # (NC, 128)
+                for j in range(bp):
+                    ohhiT = _ohT_vec(pc[j * C:(j + 1) * C],
+                                     HI_SH, HI_M, A_HI, C)  # pad -> 0 col
+                    acc += jnp.dot(ohhiT, rhs[j * C:(j + 1) * C],
+                                   preferred_element_type=jnp.float32)
         g_ref[tb] = acc
 
 
@@ -324,15 +362,17 @@ def _build_bwd(spec: TileSpec):
     T, TB = spec.tiles, spec.tiles_step
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
 
+    bp = _bp(spec)
+
     @jax.jit
     def bwd(pw, dual_rows):
-        dg = dual_rows.reshape(S, RH, RL).astype(jnp.bfloat16)
+        dg = dual_rows.reshape(S // bp, bp * RH, RL).astype(jnp.bfloat16)
         g = pl.pallas_call(
             partial(_bwd_kernel, spec),
             grid=(T // TB,),
             in_specs=[
                 pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
-                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+                pl.BlockSpec((S // bp, bp * RH, RL), lambda t: (0, 0, 0)),
             ],
             out_specs=pl.BlockSpec((TB, A_HI, B_LO), lambda t: (t, 0, 0)),
             out_shape=jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),

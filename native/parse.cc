@@ -297,6 +297,41 @@ int64_t wh_parse_count(const char* fmt, const char* buf, int64_t len,
   return 0;
 }
 
+// text -> crec v1 block assembly: fold 64-bit parser ids to u32
+// (splitmix64 truncation, the key64_to_key32 spec in data/hashing.py),
+// truncate/sentinel-pad each row to the fixed nnz width, binarize labels
+// — the whole per-row Python glue of the text ingest path in one pass
+// over the cached parse. Returns rows written, or -1 on parse failure.
+// Caller sizes keys as rows*nnz (rows from wh_parse_count).
+int64_t wh_parse_to_crec(const char* fmt, const char* buf, int64_t len,
+                         int32_t nnz, uint32_t* keys, uint8_t* labels) {
+  if (buf != g_key_buf || len != g_key_len ||
+      strncmp(fmt, g_key_fmt, sizeof(g_key_fmt)) != 0) {
+    if (!parse(fmt, buf, len, &g_cache)) return -1;
+  }
+  const Parsed& c = g_cache;
+  const int64_t rows = static_cast<int64_t>(c.labels.size());
+  for (int64_t i = 0; i < rows; ++i) {
+    const int64_t s = c.offsets[i];
+    int64_t m = c.offsets[i + 1] - s;
+    if (m > nnz) m = nnz;  // positional truncation (text2rec semantics)
+    uint32_t* row = keys + i * nnz;
+    for (int64_t j = 0; j < m; ++j) {
+      uint64_t x = c.index[s + j] + 0x9E3779B97F4A7C15ULL;  // splitmix64
+      x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+      x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+      x ^= x >> 31;
+      uint32_t k = static_cast<uint32_t>(x);
+      if (k == 0xFFFFFFFFu) k = 0xFFFFFFFEu;  // sentinel is reserved
+      row[j] = k;
+    }
+    for (int64_t j = m; j < nnz; ++j) row[j] = 0xFFFFFFFFu;
+    labels[i] = c.labels[i] > 0.5f ? 1 : 0;
+  }
+  g_key_buf = nullptr;
+  return rows;
+}
+
 int wh_parse_fill(const char* fmt, const char* buf, int64_t len,
                   int64_t* offsets, float* labels, uint64_t* index,
                   float* values, int* has_value) {

@@ -192,8 +192,12 @@ def test_async_sgd_runs_on_crec(tmp_path, rng):
     cfg = Config(train_data=str(path), val_data=str(path),
                  data_format="crec", algo=__import__(
                      "wormhole_tpu.utils.config", fromlist=["Algo"]).Algo.FTRL,
-                 max_data_pass=3, max_delay=2, num_buckets=NB,
+                 max_data_pass=6, max_delay=2, num_buckets=NB,
                  lr_eta=0.3, disp_itv=1e9)
+    # 6 passes (was 3): on a multi-device mesh the v1 path now groups
+    # data_axis_size blocks per update (round-4 mesh dense step), so this
+    # 10-block set gets ~2 updates/pass instead of 10 — same converged
+    # quality, fewer optimizer steps per pass
     cfg.lambda_ = [0.0, 0.01]
     app = AsyncSGD(cfg, MeshRuntime.create())
     prog = app.run()

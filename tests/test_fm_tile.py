@@ -1,0 +1,211 @@
+"""FM / wide&deep crec2 tile fast path vs the sparse gather/scatter path.
+
+VERDICT r3 Missing #3: the stretch models previously trained only through
+the sparse step; these tests pin the new multi-channel tile path (pooled
+pulls + split pushes) to the sparse path's math on identical rows — same
+buckets, same update rule — and prove end-to-end learning through the
+AsyncSGD driver over a real crec2 file.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wormhole_tpu.data.hashing import fold_keys32
+from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.models.fm import FMConfig, FMStore
+from wormhole_tpu.ops import tilemm
+
+NB = 2 * tilemm.TILE      # 2 tiles
+NNZ = 4
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(5)
+
+
+def _make_rows(rng, n):
+    """Distinct keys per row (bucket collisions across rows are fine)."""
+    keys = np.empty((n, NNZ), np.uint32)
+    for i in range(n):
+        keys[i] = rng.choice(1 << 20, size=NNZ, replace=False).astype(
+            np.uint32) + 1
+    labels = (rng.random(n) < 0.5).astype(np.uint8)
+    return keys, labels
+
+
+def _tile_block(keys, labels, spec, oc=1024):
+    """Encode rows exactly as the crec2 writer would (same fold)."""
+    n = len(labels)
+    buckets = fold_keys32(keys.reshape(-1), spec.nb).astype(np.int64)
+    rows = np.repeat(np.arange(n, dtype=np.int64), keys.shape[1])
+    pw, ovb, ovr = tilemm.encode_block(buckets, rows, spec)
+    ovb_p = np.full(oc, 0xFFFFFFFF, np.uint32)
+    ovr_p = np.zeros(oc, np.uint32)
+    ovb_p[:len(ovb)] = ovb
+    ovr_p[:len(ovr)] = ovr
+    lab = np.full(spec.block_rows, 255, np.uint8)
+    lab[:n] = labels
+    return {"pw": jnp.asarray(pw), "labels": jnp.asarray(lab),
+            "ovf_b": jnp.asarray(ovb_p), "ovf_r": jnp.asarray(ovr_p)}
+
+
+def _sparse_batch(keys, labels, nb):
+    n, nnz = keys.shape
+    buckets = fold_keys32(keys.reshape(-1), nb).reshape(n, nnz)
+    uniq = np.unique(buckets)
+    cols = np.searchsorted(uniq, buckets).astype(np.int32)
+    return SparseBatch(
+        cols=jnp.asarray(cols),
+        vals=jnp.ones((n, nnz), jnp.float32),
+        labels=jnp.asarray(labels.astype(np.float32)),
+        row_mask=jnp.ones(n, jnp.float32),
+        uniq_keys=jnp.asarray(uniq.astype(np.int32)),
+        key_mask=jnp.ones(len(uniq), jnp.float32))
+
+
+class _Info:
+    """Minimal stand-in for CRec2Info (spec + ovf_cap is all the tile
+    step reads)."""
+
+    def __init__(self, spec, ovf_cap):
+        self.spec = spec
+        self.ovf_cap = ovf_cap
+
+    def __hash__(self):
+        return hash((self.spec, self.ovf_cap))
+
+    def __eq__(self, other):
+        return (self.spec, self.ovf_cap) == (other.spec, other.ovf_cap)
+
+
+def test_fm_tile_step_matches_sparse_step(rng):
+    """One FM training step through the tile kernels reproduces the
+    sparse gather/scatter step on identical rows: same margins (bf16
+    kernel-value tolerance), same touched set, same updated table."""
+    n = tilemm.RSUB            # one subblock
+    keys, labels = _make_rows(rng, n)
+    from wormhole_tpu.data.crec import default_cap
+    spec = tilemm.make_spec(NB, 1, default_cap(NNZ, NB))
+    info = _Info(spec, 1024)
+    cfg = FMConfig(num_buckets=NB, dim=4, seed=3)
+    a = FMStore(cfg)           # sparse path
+    b = FMStore(cfg)           # tile path (identical init)
+    np.testing.assert_array_equal(np.asarray(a.slots), np.asarray(b.slots))
+    a.train_step(_sparse_batch(keys, labels, NB))
+    b.tile_train_step(_tile_block(keys, labels, spec), info)
+    sa, sb = np.asarray(a.slots), np.asarray(b.slots)
+    touched_a = np.any(sa != np.asarray(FMStore(cfg).slots), axis=1)
+    touched_b = np.any(sb != np.asarray(FMStore(cfg).slots), axis=1)
+    np.testing.assert_array_equal(touched_a, touched_b)
+    # updated rows agree to bf16-value tolerance (the tile kernels round
+    # table values through bf16 — rel ~2^-8 on an init_scale=0.01 table
+    # gives ~1e-3 absolute wiggle; the sparse path is all-f32)
+    np.testing.assert_allclose(sb[touched_b], sa[touched_a],
+                               rtol=0.02, atol=2e-3)
+    # eval margins agree too
+    ma = np.asarray(a.eval_step(_sparse_batch(keys, labels, NB))[4])
+    mb = np.asarray(b.tile_eval_step(_tile_block(keys, labels, spec),
+                                     info)[5])[:n]
+    np.testing.assert_allclose(mb, ma, rtol=0.02, atol=2e-3)
+
+
+def test_fm_crec2_end_to_end_learns(tmp_path, rng):
+    """AsyncSGD + FMStore over a real crec2 file: the interaction term
+    learns an XOR of two planted keys (linearly inseparable — only a
+    working FM second-order path can separate it)."""
+    from wormhole_tpu.data.crec import CRec2Writer
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    import jax
+    n = 6000
+    keys, _ = _make_rows(rng, n)
+    a = rng.random(n) < 0.5
+    b = rng.random(n) < 0.5
+    keys[:, 0] = np.where(a, 1111, 2222)
+    keys[:, 1] = np.where(b, 3333, 4444)
+    labels = (a ^ b).astype(np.uint8)
+    path = tmp_path / "fm.crec2"
+    with CRec2Writer(str(path), nnz=NNZ, nb=NB, subblocks=1) as w:
+        w.append(keys, labels)
+    cfg = Config(train_data=str(path), data_format="crec2",
+                 num_buckets=NB, max_data_pass=15, disp_itv=1e12,
+                 max_delay=1)
+    store = FMStore(FMConfig(num_buckets=NB, dim=8, lr_alpha=0.3,
+                             seed=1))
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:1", jax.devices()[:1])
+    app = AsyncSGD(cfg, rt, store=store)
+    prog = app.run()
+    assert prog.num_ex == 15 * n
+    # late-pass accuracy: average over the last third of passes
+    assert prog.acc / max(prog.count, 1) > 0.7
+
+
+def test_wide_deep_tile_step_matches_sparse_step(rng):
+    """One wide&deep training step through the tile kernels reproduces
+    the sparse gather/scatter step: same touched set, same table, same
+    MLP update (bf16 kernel-value tolerance)."""
+    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
+    n = tilemm.RSUB
+    keys, labels = _make_rows(rng, n)
+    from wormhole_tpu.data.crec import default_cap
+    spec = tilemm.make_spec(NB, 1, default_cap(NNZ, NB))
+    info = _Info(spec, 1024)
+    cfg = WideDeepConfig(num_buckets=NB, dim=4, hidden=(16,), seed=3)
+    a = WideDeepStore(cfg)
+    b = WideDeepStore(cfg)
+    a.train_step(_sparse_batch(keys, labels, NB))
+    b.tile_train_step(_tile_block(keys, labels, spec), info)
+    sa, sb = np.asarray(a.slots), np.asarray(b.slots)
+    fresh = np.asarray(WideDeepStore(cfg).slots)
+    touched_a = np.any(sa != fresh, axis=1)
+    touched_b = np.any(sb != fresh, axis=1)
+    np.testing.assert_array_equal(touched_a, touched_b)
+    # bf16-rounded pooled inputs can flip a ReLU near its threshold,
+    # discretely changing a handful of bucket gradients — so the table
+    # comparison is quantile-based: the bulk must match to bf16
+    # tolerance, and even the flipped tail must stay bounded
+    diff = np.abs(sb[touched_b] - sa[touched_a])
+    assert np.quantile(diff, 0.99) < 5e-3, np.quantile(diff, 0.99)
+    assert diff.max() < 0.5, diff.max()
+    for kname in a.mlp:
+        np.testing.assert_allclose(np.asarray(b.mlp[kname]),
+                                   np.asarray(a.mlp[kname]),
+                                   rtol=0.05, atol=5e-3)
+
+
+def test_wide_deep_crec2_end_to_end_learns(tmp_path, rng):
+    """AsyncSGD + WideDeepStore over a real crec2 file: the MLP over
+    pooled embeddings learns an XOR of two planted keys."""
+    from wormhole_tpu.data.crec import CRec2Writer
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    import jax
+    n = 6000
+    keys, _ = _make_rows(rng, n)
+    a = rng.random(n) < 0.5
+    b = rng.random(n) < 0.5
+    keys[:, 0] = np.where(a, 1111, 2222)
+    keys[:, 1] = np.where(b, 3333, 4444)
+    labels = (a ^ b).astype(np.uint8)
+    path = tmp_path / "wd.crec2"
+    with CRec2Writer(str(path), nnz=NNZ, nb=NB, subblocks=1) as w:
+        w.append(keys, labels)
+    cfg = Config(train_data=str(path), data_format="crec2",
+                 num_buckets=NB, max_data_pass=20, disp_itv=1e12,
+                 max_delay=1)
+    store = WideDeepStore(WideDeepConfig(
+        num_buckets=NB, dim=8, hidden=(32,), lr_alpha=0.3,
+        lr_alpha_dense=0.1, init_scale=0.1, seed=1))
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:1", jax.devices()[:1])
+    app = AsyncSGD(cfg, rt, store=store)
+    prog = app.run()
+    assert prog.num_ex == 20 * n
+    assert prog.acc / max(prog.count, 1) > 0.7

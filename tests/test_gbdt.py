@@ -192,3 +192,84 @@ def test_sparse_loader_never_densifies(tmp_path):
     model.dump_model(str(tmp_path / "dump.txt"))
     txt = (tmp_path / "dump.txt").read_text()
     assert "[f5<" in txt or "[f9<" in txt, txt[:400]
+
+
+def _write_libsvm(path, x, y):
+    lines = []
+    for i in range(len(y)):
+        toks = [f"{j}:{x[i, j]:.3f}" for j in range(x.shape[1])
+                if x[i, j] != 0.0]
+        lines.append(f"{int(y[i])} " + " ".join(toks))
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_gbdt_external_matches_in_memory(tmp_path):
+    """External-memory boosting (streamed BinnedCache chunks, VERDICT r3
+    Missing #4) builds the same trees as the in-memory fit on identical
+    data: the chunked histogram accumulation and streamed routing must
+    reproduce the all-rows scans exactly."""
+    from wormhole_tpu.models.gbdt import GBDT, GBDTConfig, load_dense
+    rng = np.random.default_rng(17)
+    n, F = 3000, 8
+    # quantize values so the libsvm text round-trip is exact
+    x = np.round(rng.standard_normal((n, F)), 3).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 2] > 0)).astype(np.float32)
+    path = tmp_path / "train.libsvm"
+    _write_libsvm(path, x, y)
+    # in-memory reference on the SAME parsed values
+    xd, yd = load_dense(str(path), "libsvm")
+    ref = GBDT(GBDTConfig(num_round=5, max_depth=3, eta=0.5))
+    ref.fit(xd, yd)
+    # external: 128-row chunks -> resident binned bytes ~ 1/24 of the
+    # matrix; the cache file holds the rest
+    ext = GBDT(GBDTConfig(num_round=5, max_depth=3, eta=0.5))
+    ext.fit_external(str(path), "libsvm", chunk_rows=128,
+                     cache_path=str(tmp_path / "c.cache"))
+    from wormhole_tpu.models.gbdt import BinnedCache
+    cache = BinnedCache.open(str(tmp_path / "c.cache"))
+    assert cache.num_chunks >= 20      # genuinely streamed
+    assert cache.total == n
+    np.testing.assert_allclose(ref.cuts, ext.cuts, atol=1e-6)
+    assert len(ref.trees) == len(ext.trees)
+    for td, te in zip(ref.trees, ext.trees):
+        np.testing.assert_array_equal(np.asarray(td.feature),
+                                      np.asarray(te.feature))
+        np.testing.assert_array_equal(np.asarray(td.split_bin),
+                                      np.asarray(te.split_bin))
+        np.testing.assert_array_equal(np.asarray(td.is_leaf),
+                                      np.asarray(te.is_leaf))
+        np.testing.assert_allclose(np.asarray(td.weight),
+                                   np.asarray(te.weight), atol=1e-4)
+    # streamed final metric agrees with an in-memory evaluation
+    m = ext.evaluate(xd, yd)
+    assert abs(m["logloss"] - ext.history[-1]) < 1e-4
+    assert m["accuracy"] > 0.95
+
+
+def test_gbdt_external_checkpoint_resume(tmp_path):
+    """A crashed external-memory run resumes from the checkpointed round
+    with replayed margins and finishes with the same trees as an
+    uninterrupted run."""
+    from wormhole_tpu.models.gbdt import GBDT, GBDTConfig
+    rng = np.random.default_rng(19)
+    n, F = 1200, 6
+    x = np.round(rng.standard_normal((n, F)), 3).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.float32)
+    path = tmp_path / "t.libsvm"
+    _write_libsvm(path, x, y)
+    full = GBDT(GBDTConfig(num_round=6, max_depth=3))
+    full.fit_external(str(path), chunk_rows=256,
+                      cache_path=str(tmp_path / "f.cache"))
+    ck = str(tmp_path / "ck")
+    a = GBDT(GBDTConfig(num_round=3, max_depth=3, checkpoint_dir=ck))
+    a.fit_external(str(path), chunk_rows=256,
+                   cache_path=str(tmp_path / "a.cache"))
+    b = GBDT(GBDTConfig(num_round=6, max_depth=3, checkpoint_dir=ck))
+    b.fit_external(str(path), chunk_rows=256,
+                   cache_path=str(tmp_path / "b.cache"))
+    assert len(b.trees) == 6
+    for tf, tb in zip(full.trees, b.trees):
+        np.testing.assert_array_equal(np.asarray(tf.feature),
+                                      np.asarray(tb.feature))
+        np.testing.assert_allclose(np.asarray(tf.weight),
+                                   np.asarray(tb.weight), atol=1e-4)

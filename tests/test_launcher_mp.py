@@ -352,3 +352,78 @@ def test_mp_crec_v1_dense_training_converges(tmp_path):
     assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
     acc = float(rows[0].split("acc=")[1].split()[0])
     assert acc > 0.85, out
+
+
+def test_mp_straggler_reexecution_crec(tmp_path):
+    """Deterministic straggler re-execution (VERDICT r3 Weak #4): one
+    host's part is 8x the other's (uneven parts — the scenario the
+    replicated pool exists for). After the fast host drains, the big
+    part crosses the 3x-mean-ROUNDS threshold, is re-issued to the idle
+    host WITH a skip count, and the original abandons — every block
+    processed exactly once, proven by exact global row accounting."""
+    rng = np.random.default_rng(23)
+    from wormhole_tpu.data.crec import CRecWriter
+    nnz, br = 8, 512
+    sizes = {"aa_big": 24 * br, "bb_small": 3 * br}
+    for name, n in sizes.items():
+        keys = rng.integers(1, 1 << 31, size=(n, nnz), dtype=np.uint32)
+        labels = (rng.random(n) < 0.5).astype(np.uint8)
+        with CRecWriter(str(tmp_path / f"{name}.crec"), nnz=nnz,
+                        block_rows=br) as w:
+            w.append(keys, labels)
+    total = sum(sizes.values())
+    r = run_mp(2, f"""
+        from wormhole_tpu.learners.async_sgd import AsyncSGD
+        from wormhole_tpu.utils.config import load_config
+        cfg = load_config(None, [
+            "train_data={tmp_path}/*.crec", "data_format=crec",
+            "num_buckets=65536", "lr_eta=0.1", "max_data_pass=1",
+            "disp_itv=1e12"])
+        app = AsyncSGD(cfg)
+        prog = app.run()
+        print(f"OK rank {{app.rt.rank}} num_ex={{prog.num_ex}}")
+    """, timeout=420, raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    assert out.count("OK rank") == 2
+    # the mechanism actually fired...
+    assert "straggler: re-queue" in r.stderr, r.stderr
+    assert "abandoning at block" in r.stderr, r.stderr
+    # ...and accounting stayed exact: every row of every file once
+    rows = [ln for ln in out.splitlines() if "num_ex=" in ln]
+    assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
+    num_ex = int(rows[0].split("num_ex=")[1].split()[0])
+    assert num_ex == total, out
+
+
+def test_mp_straggler_reexecution_sparse(tmp_path):
+    """Same straggler handoff through the sparse/text multihost pass:
+    minibatch-granular skip, exact row accounting."""
+    rng = np.random.default_rng(29)
+    for name, rows in (("aa_big", 2400), ("bb_small", 300)):
+        lines = []
+        for _ in range(rows):
+            y = rng.random() < 0.5
+            feats = sorted(rng.choice(np.arange(2, 64), size=6,
+                                      replace=False))
+            toks = [f"{0 if y else 1}:1"] + [f"{j}:1" for j in feats]
+            lines.append(f"{int(y)} " + " ".join(toks))
+        (tmp_path / f"{name}.libsvm").write_text("\n".join(lines) + "\n")
+    r = run_mp(2, f"""
+        from wormhole_tpu.learners.async_sgd import AsyncSGD
+        from wormhole_tpu.utils.config import load_config
+        cfg = load_config(None, {CFG_COMMON.split()!r} + [
+            "train_data={tmp_path}/*.libsvm", "max_data_pass=1"])
+        app = AsyncSGD(cfg)
+        prog = app.run()
+        print(f"OK rank {{app.rt.rank}} num_ex={{prog.num_ex}}")
+    """, timeout=420, raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    assert out.count("OK rank") == 2
+    assert "straggler: re-queue" in r.stderr, r.stderr
+    assert "abandoning at block" in r.stderr, r.stderr
+    rows = [ln for ln in out.splitlines() if "num_ex=" in ln]
+    assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
+    num_ex = int(rows[0].split("num_ex=")[1].split()[0])
+    assert num_ex == 2700, out

@@ -172,3 +172,42 @@ def test_spec_validation():
         tilemm.TileSpec(nb=tilemm.TILE, subblocks=3, cap=128, group=2)
     with pytest.raises(ValueError):
         tilemm.TileSpec(nb=tilemm.TILE, subblocks=2, cap=100)
+
+
+def test_multi_channel_pulls_match_oracle():
+    """forward_pulls/backward_pushes (the FM / wide&deep embedding
+    kernels) against per-channel scatter/gather oracles, including the
+    overflow spill path."""
+    rng = np.random.default_rng(7)
+    ch = 3
+    buckets, rows = make_pairs(rng, 9000)
+    # force some overflow: one hot bucket beyond cap
+    hot = np.full(1400, 17, np.int64)
+    buckets = np.concatenate([buckets, hot])
+    rows = np.concatenate([rows, rng.integers(
+        0, SPEC.block_rows, size=1400).astype(np.int64)])
+    pw, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    assert len(ovb) > 0          # spill path exercised
+    oc = 8192
+    ovb_p = np.full(oc, 0xFFFFFFFF, np.uint32)
+    ovr_p = np.zeros(oc, np.uint32)
+    ovb_p[:len(ovb)] = ovb
+    ovr_p[:len(ovr)] = ovr
+    w = rng.normal(0, 0.5, (SPEC.nb, ch)).astype(np.float32)
+    import jax.numpy as jnp
+    pulls = np.asarray(tilemm.forward_pulls(
+        jnp.asarray(pw), jnp.asarray(w), SPEC,
+        jnp.asarray(ovb_p), jnp.asarray(ovr_p)))
+    w16 = w.astype(np.float32)
+    for jc in range(ch):
+        want = tilemm.forward_margins_ref(buckets, rows, w16[:, jc],
+                                          SPEC.block_rows)
+        np.testing.assert_allclose(pulls[:, jc], want, rtol=0, atol=0.15)
+    dual = rng.normal(0, 1.0, (SPEC.block_rows, ch)).astype(np.float32)
+    g = np.asarray(tilemm.backward_pushes(
+        jnp.asarray(pw), jnp.asarray(dual), SPEC,
+        jnp.asarray(ovb_p), jnp.asarray(ovr_p)))
+    for jc in range(ch):
+        want = tilemm.backward_grad_ref(buckets, rows, dual[:, jc],
+                                        SPEC.nb)
+        np.testing.assert_allclose(g[:, jc], want, rtol=0, atol=0.15)

@@ -101,7 +101,11 @@ def pad_to_batch(loc: Localized, minibatch_size: int,
 
     k = len(loc.uniq_keys)
     kpad = key_pad or next_bucket(k)
-    assert k <= kpad, (k, kpad)
+    if k > kpad:
+        raise ValueError(
+            f"batch has {k} unique keys but key_pad={kpad}: raise "
+            "key_pad (it must cover minibatch x max row nnz worth of "
+            "distinct hashed keys) or lower minibatch")
     if k and int(loc.uniq_keys.max()) > np.iinfo(key_dtype).max:
         raise OverflowError(
             f"uniq key {int(loc.uniq_keys.max())} exceeds {np.dtype(key_dtype)}; "

@@ -92,8 +92,47 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64),  # index   (nnz)
         ctypes.POINTER(ctypes.c_float),   # values  (nnz)
         ctypes.POINTER(ctypes.c_int)]     # has_value flag out
+    if hasattr(lib, "wh_parse_to_crec"):
+        lib.wh_parse_to_crec.restype = ctypes.c_int64
+        lib.wh_parse_to_crec.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32),  # keys (rows*nnz)
+            ctypes.POINTER(ctypes.c_uint8)]   # labels (rows)
     _LIB = lib
     return _LIB
+
+
+def get_crec_assembler(fmt: str, nnz: int):
+    """C-side text chunk -> crec row assembly: parse + key64->u32 fold +
+    fixed-nnz sentinel padding + label binarization in one native pass
+    (the per-row Python glue the round-3 verdict measured as the text
+    ingest bottleneck). Returns fn(chunk) -> (keys (n, nnz) u32,
+    labels (n,) u8), or None when the library (or symbol) is absent."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "wh_parse_to_crec"):
+        return None
+    if fmt not in ("libsvm", "criteo", "adfea"):
+        return None
+    cfmt = fmt.encode()
+
+    def assemble(chunk: bytes):
+        counts = (ctypes.c_int64 * 2)()
+        rc = lib.wh_parse_count(cfmt, chunk, len(chunk), counts)
+        if rc < 0:
+            raise ValueError(f"native parse_count failed for {fmt}")
+        rows = counts[0]
+        keys = np.empty((max(rows, 1), nnz), np.uint32)
+        labels = np.empty(max(rows, 1), np.uint8)
+        got = lib.wh_parse_to_crec(
+            cfmt, chunk, len(chunk), nnz,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if got != rows:
+            raise ValueError(f"native crec assembly failed for {fmt}")
+        return keys[:rows], labels[:rows]
+
+    return assemble
 
 
 def get_parser(fmt: str) -> Optional[Callable[[bytes], RowBlock]]:

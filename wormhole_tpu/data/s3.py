@@ -40,7 +40,9 @@ import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from wormhole_tpu.data.stream import FileInfo, FileSystem
+from wormhole_tpu.data.stream import (FileInfo, FileSystem,
+                                      RangedReadStream,
+                                      UploadOnCloseBuffer)
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
@@ -250,64 +252,31 @@ class S3FileSystem(FileSystem):
         return int(hdr.get("Content-Length", 0))
 
 
-class _S3ReadStream(io.RawIOBase):
-    """Raw byte-range reader: each readinto() beyond the current position
-    issues one ranged GET of at least ``read_chunk`` bytes (the
-    BufferedReader wrapper coalesces small reads)."""
+class _S3ReadStream(RangedReadStream):
+    """Byte-range GETs through the shared ranged-read scaffolding (the
+    BufferedReader wrapper coalesces small reads into chunk fetches)."""
 
     def __init__(self, fs: S3FileSystem, bucket: str, key: str) -> None:
-        self._fs, self._bucket, self._key = fs, bucket, key
-        self._pos = 0
-        self._size = fs.size(f"s3://{bucket}/{key}")
+        def fetch(lo: int, want: int) -> bytes:
+            st, _, data = fs._request(
+                "GET", bucket, key,
+                extra_headers={"Range": f"bytes={lo}-{lo + want - 1}"})
+            if st == 416:
+                return b""
+            fs._check(st, data, f"read s3://{bucket}/{key}")
+            return data
 
-    def readable(self) -> bool:
-        return True
-
-    def seekable(self) -> bool:
-        return True
-
-    def seek(self, off: int, whence: int = io.SEEK_SET) -> int:
-        base = (0 if whence == io.SEEK_SET
-                else self._pos if whence == io.SEEK_CUR else self._size)
-        self._pos = max(0, base + off)
-        return self._pos
-
-    def tell(self) -> int:
-        return self._pos
-
-    def readinto(self, b) -> int:
-        if self._pos >= self._size or not len(b):
-            return 0
-        want = min(len(b), self._size - self._pos)
-        lo, hi = self._pos, self._pos + want - 1
-        st, _, data = self._fs._request(
-            "GET", self._bucket, self._key,
-            extra_headers={"Range": f"bytes={lo}-{hi}"})
-        if st == 416:
-            return 0
-        self._fs._check(st, data, f"read s3://{self._bucket}/{self._key}")
-        n = min(len(data), want)
-        b[:n] = data[:n]
-        self._pos += n
-        return n
+        super().__init__(fs.size(f"s3://{bucket}/{key}"), fetch)
 
 
-class _S3WriteBuffer(io.BytesIO):
-    """Local seekable buffer PUT to S3 on close (header backpatching in
-    the crec writers works; S3 objects are immutable so there is no
-    streaming-write shortcut worth its complexity at model-file sizes)."""
+class _S3WriteBuffer(UploadOnCloseBuffer):
+    """PUT-on-close through the shared upload scaffolding (S3 objects
+    are immutable; no streaming-write shortcut is worth its complexity
+    at model-file sizes)."""
 
     def __init__(self, fs: S3FileSystem, bucket: str, key: str) -> None:
-        super().__init__()
-        self._fs, self._bucket, self._key = fs, bucket, key
-        self._done = False
+        def upload(body: bytes) -> None:
+            st, _, data = fs._request("PUT", bucket, key, body=body)
+            fs._check(st, data, f"write s3://{bucket}/{key}")
 
-    def close(self) -> None:
-        if not self._done:
-            self._done = True
-            body = self.getvalue()
-            st, _, data = self._fs._request(
-                "PUT", self._bucket, self._key, body=body)
-            self._fs._check(st, data,
-                            f"write s3://{self._bucket}/{self._key}")
-        super().close()
+        super().__init__(upload)

@@ -64,6 +64,66 @@ class LocalFileSystem(FileSystem):
         return os.path.getsize(_strip_scheme(uri))
 
 
+class RangedReadStream(io.RawIOBase):
+    """Raw seekable reader over a byte-range fetch callable — the shared
+    scaffolding of the remote read streams (S3 ranged GET, WebHDFS
+    OPEN offset/length). Wrap in io.BufferedReader so small reads
+    coalesce into chunk-sized fetches."""
+
+    def __init__(self, size: int, fetch) -> None:
+        """``fetch(lo, want) -> bytes`` returns up to ``want`` bytes at
+        offset ``lo`` (may return fewer; empty means EOF-ish)."""
+        self._size = size
+        self._fetch = fetch
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, off: int, whence: int = io.SEEK_SET) -> int:
+        base = (0 if whence == io.SEEK_SET
+                else self._pos if whence == io.SEEK_CUR else self._size)
+        self._pos = max(0, base + off)
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readinto(self, b) -> int:
+        if self._pos >= self._size or not len(b):
+            return 0
+        want = min(len(b), self._size - self._pos)
+        data = self._fetch(self._pos, want)
+        n = min(len(data), want)
+        b[:n] = data[:n]
+        self._pos += n
+        return n
+
+
+class UploadOnCloseBuffer(io.BytesIO):
+    """Local seekable buffer whose contents upload once on close — the
+    shared write-side scaffolding of the remote streams. Seekability
+    means header-backpatching writers (crec/crec2, BinnedCache) work
+    unchanged. ``_done`` flips only AFTER a successful upload, so a
+    caller that catches a transient failure can call close() again and
+    actually retry instead of silently succeeding."""
+
+    def __init__(self, upload) -> None:
+        """``upload(body: bytes)`` raises on failure."""
+        super().__init__()
+        self._upload = upload
+        self._done = False
+
+    def close(self) -> None:
+        if not self._done:
+            self._upload(self.getvalue())   # raises -> retryable
+            self._done = True
+        super().close()
+
+
 class _LazyFileSystem(FileSystem):
     """Defers constructing a backend until first use, so importing the
     data plane never pays for (or requires) remote-FS configuration."""

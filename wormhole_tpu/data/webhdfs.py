@@ -25,7 +25,9 @@ import os
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
-from wormhole_tpu.data.stream import FileInfo, FileSystem
+from wormhole_tpu.data.stream import (FileInfo, FileSystem,
+                                      RangedReadStream,
+                                      UploadOnCloseBuffer)
 
 DEFAULT_PORT = 9870
 
@@ -121,65 +123,33 @@ class WebHDFSFileSystem(FileSystem):
         return int(json.loads(data)["FileStatus"]["length"])
 
 
-class _HDFSReadStream(io.RawIOBase):
+class _HDFSReadStream(RangedReadStream):
     def __init__(self, fs: WebHDFSFileSystem, host: str, port: int,
                  path: str) -> None:
-        self._fs, self._host, self._port, self._path = fs, host, port, path
-        self._pos = 0
-        self._size = fs.size(f"hdfs://{host}:{port}{path}")
+        def fetch(lo: int, want: int) -> bytes:
+            st, _, data = fs._request(
+                "GET", fs._url(host, port, path, "OPEN",
+                               offset=str(lo), length=str(want)))
+            fs._check(st, data, f"read {path}")
+            return data
 
-    def readable(self) -> bool:
-        return True
-
-    def seekable(self) -> bool:
-        return True
-
-    def seek(self, off: int, whence: int = io.SEEK_SET) -> int:
-        base = (0 if whence == io.SEEK_SET
-                else self._pos if whence == io.SEEK_CUR else self._size)
-        self._pos = max(0, base + off)
-        return self._pos
-
-    def tell(self) -> int:
-        return self._pos
-
-    def readinto(self, b) -> int:
-        if self._pos >= self._size or not len(b):
-            return 0
-        want = min(len(b), self._size - self._pos)
-        st, _, data = self._fs._request(
-            "GET", self._fs._url(self._host, self._port, self._path,
-                                 "OPEN", offset=str(self._pos),
-                                 length=str(want)))
-        self._fs._check(st, data, f"read {self._path}")
-        n = min(len(data), want)
-        b[:n] = data[:n]
-        self._pos += n
-        return n
+        super().__init__(fs.size(f"hdfs://{host}:{port}{path}"), fetch)
 
 
-class _HDFSWriteBuffer(io.BytesIO):
+class _HDFSWriteBuffer(UploadOnCloseBuffer):
     def __init__(self, fs: WebHDFSFileSystem, host: str, port: int,
                  path: str) -> None:
-        super().__init__()
-        self._fs, self._host, self._port, self._path = fs, host, port, path
-        self._done = False
-
-    def close(self) -> None:
-        if not self._done:
-            self._done = True
-            fs = self._fs
+        def upload(body: bytes) -> None:
             # protocol-faithful two-step: CREATE with no body against the
             # NameNode, then the data to the DataNode it redirects to
-            url = fs._url(self._host, self._port, self._path,
-                          "CREATE", overwrite="true")
+            url = fs._url(host, port, path, "CREATE", overwrite="true")
             st, hdr, data = fs._request("PUT", url, follow=0)
             if st in (301, 302, 307) and hdr.get("Location"):
                 st, _, data = fs._request("PUT", hdr["Location"],
-                                          body=self.getvalue(), follow=0)
+                                          body=body, follow=0)
             elif st < 300:
                 # single-step server: resend with the body attached
-                st, _, data = fs._request("PUT", url,
-                                          body=self.getvalue(), follow=2)
-            fs._check(st, data, f"write {self._path}")
-        super().close()
+                st, _, data = fs._request("PUT", url, body=body, follow=2)
+            fs._check(st, data, f"write {path}")
+
+        super().__init__(upload)

@@ -37,7 +37,9 @@ from wormhole_tpu.learners.store import ShardedStore, StoreConfig
 from wormhole_tpu.ops.penalty import L1L2
 from wormhole_tpu.ops.tilemm import PADWORD
 from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
-from wormhole_tpu.sched.workload_pool import TRAIN, VAL, WorkloadPool
+from wormhole_tpu.sched.workload_pool import (TRAIN, VAL,
+                                              ReplicatedRounds,
+                                              WorkloadPool)
 from wormhole_tpu.utils.config import Config
 from wormhole_tpu.utils.logging import get_logger
 from wormhole_tpu.utils.progress import (ModelMonitor, Progress,
@@ -160,7 +162,8 @@ class AsyncSGD:
         can compute pass-level metrics over the full eval output (the
         reference evaluates AUC over the complete pass, evaluation.h:38-68,
         not a mean of per-minibatch AUCs)."""
-        if self.cfg.data_format in ("crec", "crec2"):
+        if self.cfg.data_format in ("crec", "crec2") \
+                or self._text_dense():
             return self._process_crec(file, part, nparts, kind, pooled)
         cfg = self.cfg
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
@@ -238,18 +241,43 @@ class AsyncSGD:
                 harvest(inflight.popleft())
         return local
 
+    def _text_dense(self) -> bool:
+        """True when this text format streams through the dense-apply
+        fast path (native chunk -> crec-block assembly; binary-feature
+        formats only — libsvm may carry values, so it keeps the sparse
+        path)."""
+        return (self.cfg.text_dense
+                and self.cfg.data_format in ("criteo", "adfea"))
+
+    def _text_nnz(self) -> int:
+        if self.cfg.data_format == "criteo":
+            return 39
+        if not self.cfg.max_nnz:
+            raise ValueError("text_dense for adfea needs max_nnz= (the "
+                             "fixed crec row width)")
+        return self.cfg.max_nnz
+
+    def _make_feed(self, file: str, part: int, nparts: int, fmt: str,
+                   device_put=None, cache: bool = False):
+        from wormhole_tpu.data.crec import PackedFeed, TextCRecFeed
+        if fmt in ("crec", "crec2"):
+            return PackedFeed(file, part, nparts, fmt=fmt, cache=cache,
+                              device_put=device_put)
+        return TextCRecFeed(file, part, nparts, text_fmt=fmt,
+                            nnz=self._text_nnz(),
+                            block_rows=self.cfg.text_block_rows,
+                            cache=cache, device_put=device_put)
+
     def _feed(self, file: str, part: int, nparts: int, fmt: str):
-        """PackedFeed per (file, part), kept across data passes so
-        cache_device replays HBM-resident blocks instead of re-streaming
-        over the host interconnect."""
+        """Feed per (file, part), kept across data passes so cache_device
+        replays HBM-resident blocks instead of re-streaming over the host
+        interconnect."""
         if not self.cfg.cache_device:
-            from wormhole_tpu.data.crec import PackedFeed
-            return PackedFeed(file, part, nparts, fmt=fmt)
+            return self._make_feed(file, part, nparts, fmt)
         key = (file, part, nparts, fmt)
         feed = self._feeds.get(key) if hasattr(self, "_feeds") else None
         if feed is None:
-            from wormhole_tpu.data.crec import PackedFeed
-            feed = PackedFeed(file, part, nparts, fmt=fmt, cache=True)
+            feed = self._make_feed(file, part, nparts, fmt, cache=True)
             if not hasattr(self, "_feeds"):
                 self._feeds = {}
             self._feeds[key] = feed
@@ -338,7 +366,15 @@ class AsyncSGD:
                     f"store {type(self.store).__name__} has no dense-apply "
                     "step; crec streaming needs the table-backed "
                     "ShardedStore")
-            info = read_header(file)
+            if fmt == "crec":
+                info = read_header(file)
+            else:
+                # dense text fast path: in-memory crec blocks assembled
+                # natively (TextCRecFeed); geometry comes from config
+                from wormhole_tpu.data.crec import CRecInfo
+                info = CRecInfo(nnz=self._text_nnz(),
+                                block_rows=cfg.text_block_rows,
+                                total_rows=0)
             lab_off = info.block_rows * info.nnz * 4
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         tau_cap = float(max(cfg.max_delay - 1, 0))
@@ -407,7 +443,13 @@ class AsyncSGD:
                 return host            # cached item: already labels-only
             return host[lab_off:lab_off + info.block_rows].copy()
 
-        if self.rt.mesh.size > 1:
+        has_mesh_step = hasattr(
+            self.store, "tile_train_step_mesh" if fmt == "crec2"
+            else "dense_train_step_mesh")  # text rides the dense step
+        if self.rt.mesh.size > 1 and has_mesh_step:
+            # stores without a mesh step (FM / wide&deep embedding
+            # tables) run the single-device tile path on their own
+            # placement
             return self._process_crec_mesh(file, part, nparts, kind,
                                            pooled, info, local, fmt)
         pfx = "" if kind == TRAIN else "eval_"
@@ -492,8 +534,8 @@ class AsyncSGD:
         pfx = "" if kind == TRAIN else "eval_"
         # no-op device_put: the mesh step jits host arrays straight onto
         # their (data, model)-sharded layout
-        feed = PackedFeed(file, part, nparts, fmt=fmt,
-                          device_put=lambda x: x)
+        feed = self._make_feed(file, part, nparts, fmt,
+                               device_put=lambda x: x)
         group: list = []
 
         # shared pad arrays — building them per dispatch would allocate
@@ -780,10 +822,14 @@ class AsyncSGD:
         from wormhole_tpu.parallel.collectives import allreduce_tree
         cfg = self.cfg
         world = self.rt.world
-        pool = WorkloadPool(straggler_factor=float("inf"))
+        # rounds-based straggler re-execution: deterministic across the
+        # replicated pools (see ReplicatedRounds)
+        pool = WorkloadPool(straggler_factor=cfg.straggler_factor)
         pool.add(pattern, cfg.num_parts_per_file, kind)
+        rr = ReplicatedRounds(pool, world, self.rt.rank)
         my_it = None
         my_wl = None
+        my_skip = 0
         drained = False
         finished_id = -1
         local = Progress()
@@ -810,36 +856,66 @@ class AsyncSGD:
                 if blk is None:
                     finished_id = my_wl.id
                     my_it = None
-            need = my_it is None and not drained
-            # one exchange per global step: (finished part, need, drained)
+                else:
+                    rr.produced(1)
+            # drained hosts stay needy: a straggler re-issue must find a
+            # claimant (drained flips back off when the pool hands work)
+            need = my_it is None
+            # one exchange per global step:
+            # (finished part, need, drained, blocks contributed)
             status = multihost_utils.process_allgather(
-                np.asarray([finished_id, int(need), int(drained)],
-                           np.int64))
+                rr.status_row(finished_id, need, drained))
             finished_id = -1
+            rr.advance(status)
             # identical pool transitions on every replica, in rank order
             for r in range(world):
                 if status[r, 0] >= 0:
-                    pool.finish(int(status[r, 0]))
+                    rr.finished(int(status[r, 0]))
+            any_claimed = False
             for r in range(world):
                 if status[r, 1]:
                     wl = pool.get(f"proc{r}")
+                    if wl is not None:
+                        any_claimed = True
+                        if rr.reclaimed_from(wl, r):
+                            # straggler handoff: the new holder resumes
+                            # at our skip point; stop WITHOUT finishing
+                            log.info("part %d re-issued to proc%d; "
+                                     "abandoning at block %d", wl.id, r,
+                                     rr._progress.get(wl.id, 0))
+                            my_it = None
+                            my_wl = None
+                            rr.abandon()
+                        skip = rr.claimed(r, wl)
+                    else:
+                        skip = 0
                     if r == self.rt.rank:
                         my_wl = wl
+                        my_skip = skip
             if need:
                 if my_wl is None:
                     drained = True
                 else:
+                    drained = False
                     my_it = self._batches(my_wl.file, my_wl.part,
                                           my_wl.nparts, pfx)
+                    if my_skip:
+                        from itertools import islice
+                        my_it = islice(my_it, my_skip, None)
                     with self.timer.scope(pfx + "parse"):
                         blk = next(my_it, None)
                     if blk is None:       # empty part: finish next round
                         finished_id = my_wl.id
                         my_it = None
+                    else:
+                        rr.produced(1)
             have = int(allreduce_tree(np.int64(blk is not None),
                                       self.rt.mesh, "sum"))
             if have == 0:
-                if bool(np.all(status[:, 2])) and not need:
+                # global decision: status and the pool (hence any_claimed)
+                # are identical on every replica. A pending finished_id
+                # implies any_claimed (only an empty claim sets it here).
+                if bool(np.all(status[:, 2])) and not any_claimed:
                     break
                 continue
             batch = blk if blk is not None else self._empty_local_batch()
@@ -887,8 +963,12 @@ class AsyncSGD:
         world = self.rt.world
         dpa = self.rt.data_axis_size
         dlocal = dpa // world          # data-axis indices per host
-        pool = WorkloadPool(straggler_factor=float("inf"))
+        # rounds-based straggler re-execution: deterministic across the
+        # replicated pools (see ReplicatedRounds)
+        pool = WorkloadPool(straggler_factor=cfg.straggler_factor)
         pool.add(pattern, cfg.num_parts_per_file, kind)
+        rr = ReplicatedRounds(pool, world, self.rt.rank)
+        my_skip = 0
         # headers are geometry-identical across a dataset's files (the
         # check below re-verifies per opened file)
         read_hdr = read_header2 if fmt == "crec2" else read_header
@@ -901,7 +981,7 @@ class AsyncSGD:
         hist_tot = [np.zeros(512), np.zeros(512)]
         pfx = "" if kind == TRAIN else "eval_"
 
-        def feed_iter(wl):
+        def feed_iter(wl, skip=0):
             hdr = read_hdr(wl.file)
             if fmt == "crec2":
                 same = (hdr.nb == cfg.num_buckets
@@ -918,8 +998,15 @@ class AsyncSGD:
                     f"dataset's first file ({hdr} vs {info}) — multihost "
                     "block shards must be shape-identical across hosts")
             # host arrays only; the global device_put happens at assembly
-            return iter(PackedFeed(wl.file, wl.part, wl.nparts,
-                                   fmt=fmt, device_put=lambda x: x))
+            it = iter(PackedFeed(wl.file, wl.part, wl.nparts,
+                                 fmt=fmt, device_put=lambda x: x))
+            if skip:
+                # straggler handoff: resume after the blocks the original
+                # holder already dispatched (read-and-drop; exactness
+                # beats the saved IO)
+                from itertools import islice
+                it = islice(it, skip, None)
+            return it
 
         if fmt == "crec2":
             spec = info.spec
@@ -955,34 +1042,54 @@ class AsyncSGD:
                     my_it = None
                 else:
                     group.append(item[0])
+                    rr.produced(1)
 
         from wormhole_tpu.parallel.collectives import allreduce_tree
         while True:
             group: list = []
             collect(group)
-            need = my_it is None and not drained
+            # drained hosts stay needy: a straggler re-issue must find a
+            # claimant (drained flips back off when the pool hands work)
+            need = my_it is None
             status = multihost_utils.process_allgather(
-                np.asarray([finished_id, int(need), int(drained)],
-                           np.int64))
+                rr.status_row(finished_id, need, drained))
             finished_id = -1
+            rr.advance(status)
             for r in range(world):
                 if status[r, 0] >= 0:
-                    pool.finish(int(status[r, 0]))
+                    rr.finished(int(status[r, 0]))
+            any_claimed = False
             for r in range(world):
                 if status[r, 1]:
                     wl = pool.get(f"proc{r}")
+                    if wl is not None:
+                        any_claimed = True
+                        if rr.reclaimed_from(wl, r):
+                            log.info("part %d re-issued to proc%d; "
+                                     "abandoning at block %d", wl.id, r,
+                                     rr._progress.get(wl.id, 0))
+                            my_it = None
+                            my_wl = None
+                            rr.abandon()
+                        skip = rr.claimed(r, wl)
+                    else:
+                        skip = 0
                     if r == self.rt.rank:
                         my_wl = wl
+                        my_skip = skip
             if need:
                 if my_wl is None:
                     drained = True
                 else:
-                    my_it = feed_iter(my_wl)
+                    drained = False
+                    my_it = feed_iter(my_wl, my_skip)
                     collect(group)   # contribute in the claim round too
             have = int(allreduce_tree(np.int64(len(group)), self.rt.mesh,
                                       "sum"))
             if have == 0:
-                if bool(np.all(status[:, 2])) and not need:
+                # global decision: status and the pool (hence any_claimed)
+                # are identical on every replica
+                if bool(np.all(status[:, 2])) and not any_claimed:
                     break
                 continue
             while len(group) < dlocal:
@@ -1235,7 +1342,11 @@ class AsyncSGD:
         """Key->bucket scheme for this run's data_format (recorded in /
         checked against saved models; the crec family folds differently
         from the text formats — see data/hashing.py)."""
+        # text_dense folds on device (mix32) only single-process;
+        # run_multihost routes text through the sparse localize path
+        # (splitmix64) — the saved fold tag must follow the path that ran
         return ("mix32" if self.cfg.data_format in ("crec", "crec2")
+                or (self._text_dense() and jax.process_count() == 1)
                 else "splitmix64")
 
     def _store_io(self, op: str, path: str):

@@ -182,6 +182,36 @@ class TableCheckpoint:
     # train step returns t+1); tau takes a handful of small values and is
     # served from a cache of device constants.
 
+    # packed metric layout: [objv, num_ex, acc, wdelta2, pos[512], neg[512]]
+    MACC_LEN = 4 + 2 * 512
+
+    def _macc_buf(self):
+        if getattr(self, "_macc", None) is None:
+            self._macc = jnp.zeros(self.MACC_LEN, jnp.float32)
+        return self._macc
+
+    def fetch_metrics_async(self):
+        """Reset the on-device metric accumulator and start a NON-blocking
+        device->host copy of its final value; ``np.asarray(ticket)``
+        resolves it. The returned buffer is never donated again (the next
+        step starts a fresh accumulator), so reading it later is safe —
+        and the device pipeline never drains waiting on a metrics round
+        trip (a blocking fetch measured ~97 ms of idle per window through
+        a tunneled transport; round-3 e2etrace)."""
+        if getattr(self, "_macc", None) is None:
+            return np.zeros(self.MACC_LEN, np.float32)
+        buf = self._macc
+        self._macc = None
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:
+            pass
+        return buf
+
+    def fetch_metrics(self) -> np.ndarray:
+        """Blocking fetch-and-reset of the metric accumulator."""
+        return np.asarray(self.fetch_metrics_async())
+
     def _t_device(self):
         # int32 on device: a float32 counter freezes at 2^24 (t+1 == t)
         if getattr(self, "_t_dev", None) is None:
@@ -706,36 +736,6 @@ class ShardedStore(TableCheckpoint):
         return self._tile_step_mesh(info, "eval")(
             self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z))
-
-    # packed metric layout: [objv, num_ex, acc, wdelta2, pos[512], neg[512]]
-    MACC_LEN = 4 + 2 * 512
-
-    def _macc_buf(self):
-        if getattr(self, "_macc", None) is None:
-            self._macc = jnp.zeros(self.MACC_LEN, jnp.float32)
-        return self._macc
-
-    def fetch_metrics_async(self):
-        """Reset the on-device metric accumulator and start a NON-blocking
-        device->host copy of its final value; ``np.asarray(ticket)``
-        resolves it. The returned buffer is never donated again (the next
-        step starts a fresh accumulator), so reading it later is safe —
-        and the device pipeline never drains waiting on a metrics round
-        trip (a blocking fetch measured ~97 ms of idle per window through
-        a tunneled transport; round-3 e2etrace)."""
-        if getattr(self, "_macc", None) is None:
-            return np.zeros(self.MACC_LEN, np.float32)
-        buf = self._macc
-        self._macc = None
-        try:
-            buf.copy_to_host_async()
-        except AttributeError:
-            pass
-        return buf
-
-    def fetch_metrics(self) -> np.ndarray:
-        """Blocking fetch-and-reset of the metric accumulator."""
-        return np.asarray(self.fetch_metrics_async())
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block step over a typed block dict (crec.block2_views

@@ -32,6 +32,7 @@ node.
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
 from functools import partial
 from typing import List, Optional, Tuple
@@ -605,6 +606,186 @@ class GBDT:
             self._save_checkpoint(r + 1)
         return self
 
+    # -- external-memory (streamed) training path ----------------------------
+
+    def fit_external(self, uri: str, data_format: str = "libsvm",
+                     chunk_rows: int = 1 << 16, cache_path: str = "",
+                     num_features: int = 0, part: int = 0,
+                     nparts: int = 1,
+                     sample_cap: int = 1 << 16) -> "GBDT":
+        """External-memory boosting — the reference's xgboost
+        external-memory mode (``learn/xgboost/README.md:47-55``, cache
+        suffix in ``mushroom.hadoop.conf:33``): the binned matrix lives
+        in an on-disk BinnedCache and every pass streams it chunk by
+        chunk, so resident memory is one (chunk_rows, F) chunk plus the
+        O(n) per-row vectors (margin/node/mask — the gradient vectors
+        xgboost also keeps in RAM).
+
+        Two passes over the source build the cache (feature-count
+        discovery + labels + a first-``sample_cap``-rows quantile sample,
+        then bin+write); each tree level then streams the cache once for
+        histograms and once for routing; margins/metrics stream once per
+        round. The per-level histogram allreduce is unchanged, so
+        dsplit=row multi-process runs work identically (each process
+        streams its own part)."""
+        from wormhole_tpu.data.minibatch import MinibatchIter
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        # per-(part,rank) default: dsplit=row processes each stream their
+        # own part — a shared path would interleave two caches
+        cache_path = cache_path or (
+            f"{uri.split(';')[0]}.part{part}of{nparts}.binned.cache")
+        # pass 1: discover F, collect labels + a bounded sparse sample
+        F = num_features
+        labels_parts: List[np.ndarray] = []
+        sample_blocks: List = []
+        sampled = 0
+        for blk in MinibatchIter(uri, part, nparts, data_format,
+                                 chunk_rows):
+            if not num_features:
+                F = max(F, blk.max_index() + 1)
+            labels_parts.append(blk.label.copy())
+            if sampled < sample_cap:
+                sample_blocks.append(blk)
+                sampled += blk.size
+        if not labels_parts:
+            raise FileNotFoundError(f"no rows in {uri}")
+        labels_np = np.concatenate(labels_parts).astype(np.float32)
+        if jax.process_count() > 1 and not num_features:
+            F = int(allreduce_tree(np.int64(F), self.rt.mesh, "max"))
+        start_round = self._load_checkpoint(F)
+        if self.cuts is None:
+            sample_x = np.concatenate(
+                [_densify_block(b, F) for b in sample_blocks])[:sample_cap]
+            if jax.process_count() == 1:
+                _, self.cuts = quantile_bins(sample_x, cfg.num_bins)
+            else:
+                self.cuts = self._global_cuts(sample_x)
+        del sample_blocks
+        # pass 2: bin chunks into the on-disk cache
+        cache = BinnedCache.create(cache_path, F, chunk_rows)
+        for blk in MinibatchIter(uri, part, nparts, data_format,
+                                 chunk_rows):
+            cache.append(apply_bins(_densify_block(blk, F), self.cuts))
+        cache.close()
+        return self._boost_external(cache, labels_np, start_round)
+
+    def _boost_external(self, cache: "BinnedCache",
+                        labels_np: np.ndarray,
+                        start_round: int = 0) -> "GBDT":
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        n = cache.total
+        mask_np = np.ones(n, np.float32)
+        margin = np.full(n, self.base_margin, np.float32)
+        if self.trees:
+            # resumed: replay the checkpointed trees' margins per chunk
+            for lo, b in cache:
+                margin[lo:lo + len(b)] = np.asarray(
+                    self._margin(b, len(self.trees)))
+        for r in range(start_round, cfg.num_round):
+            tree = self._build_tree_external(cache, margin, labels_np,
+                                             mask_np)
+            tree = Tree(feature=tree.feature, split_bin=tree.split_bin,
+                        is_leaf=tree.is_leaf,
+                        weight=tree.weight * cfg.eta,
+                        default_right=tree.default_right)
+            self.trees.append(tree)
+            num_l = den_l = 0.0
+            for lo, b in cache:
+                sl = slice(lo, lo + len(b))
+                margin[sl] += np.asarray(_predict_trees(
+                    tree.feature[None], tree.split_bin[None],
+                    tree.is_leaf[None], tree.weight[None],
+                    jnp.asarray(b), depth=cfg.max_depth + 1))
+                m = jnp.asarray(margin[sl])
+                lab = jnp.asarray(labels_np[sl])
+                mk = jnp.asarray(mask_np[sl])
+                d = float(jnp.sum(mk))
+                den_l += d
+                if cfg.objective == "binary:logistic":
+                    num_l += float(logloss(lab, m, mk)) * d
+                else:
+                    num_l += float(jnp.sum((m - lab) ** 2 * mk))
+            num, den = allreduce_tree(
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+            metric = float(num) / max(float(den), 1.0)
+            self.history.append(metric)
+            log.info("round %d: train %s=%.6f (external, %d chunks)", r,
+                     "logloss" if cfg.objective == "binary:logistic"
+                     else "mse", metric, cache.num_chunks)
+            self._save_checkpoint(r + 1)
+        return self
+
+    def _build_tree_external(self, cache: "BinnedCache",
+                            margin: np.ndarray, labels_np: np.ndarray,
+                            mask_np: np.ndarray) -> Tree:
+        """_build_tree with every row scan replaced by a cache stream:
+        per level one pass accumulates the (node, feature, bin)
+        histograms chunk by chunk, a second routes rows to children."""
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        d = cfg.max_depth
+        nnodes = 2 ** (d + 1) - 1
+        feature = np.zeros(nnodes, np.int32)
+        split_bin = np.zeros(nnodes, np.int32)
+        is_leaf = np.zeros(nnodes, bool)
+        weight = np.zeros(nnodes, np.float32)
+        default_right = np.zeros(nnodes, bool)
+        n = cache.total
+        node = np.zeros(n, np.int32)
+        alive = mask_np.copy()
+        active = np.ones(1, bool)
+        for depth in range(d + 1):
+            level_nodes = 2 ** depth
+            offset = level_nodes - 1
+            gh = hh = None
+            for lo, b in cache:
+                sl = slice(lo, lo + len(b))
+                g, h = _grad_hess(jnp.asarray(margin[sl]),
+                                  jnp.asarray(labels_np[sl]),
+                                  cfg.objective)
+                gc, hc = _level_hists(
+                    jnp.asarray(b), jnp.asarray(node[sl]), g, h,
+                    jnp.asarray(alive[sl]),
+                    num_nodes=level_nodes, num_bins=cfg.num_bins)
+                gh = np.asarray(gc) if gh is None else gh + np.asarray(gc)
+                hh = np.asarray(hc) if hh is None else hh + np.asarray(hc)
+            gh, hh = allreduce_tree((gh, hh), self.rt.mesh,
+                                    compress=cfg.msg_compression)
+            do_split, bf, bb, leaf_w = _best_splits(
+                gh, hh, active, lam=cfg.reg_lambda, gamma=cfg.gamma,
+                min_child=cfg.min_child_weight)
+            if depth == d:
+                do_split[:] = False
+            ids = offset + np.arange(level_nodes)
+            newly_leaf = active & ~do_split
+            is_leaf[ids[newly_leaf]] = True
+            weight[ids[newly_leaf]] = leaf_w[newly_leaf]
+            feature[ids[do_split]] = bf[do_split]
+            split_bin[ids[do_split]] = bb[do_split]
+            if not do_split.any():
+                break
+            bfj, bbj = jnp.asarray(bf), jnp.asarray(bb)
+            for lo, b in cache:
+                sl = slice(lo, lo + len(b))
+                go = np.asarray(_route_rows(jnp.asarray(b),
+                                            jnp.asarray(node[sl]),
+                                            bfj, bbj))
+                on_split = do_split[node[sl]]
+                node[sl] = np.where(on_split, 2 * node[sl] + go, 0)
+                alive[sl] *= on_split
+            nxt_active = np.zeros(2 * level_nodes, bool)
+            sp = np.nonzero(do_split)[0]
+            nxt_active[2 * sp] = True
+            nxt_active[2 * sp + 1] = True
+            active = nxt_active
+        return Tree(feature=jnp.asarray(feature),
+                    split_bin=jnp.asarray(split_bin),
+                    is_leaf=jnp.asarray(is_leaf),
+                    weight=jnp.asarray(weight),
+                    default_right=jnp.asarray(default_right))
+
     # -- sparse (CSR-entry) training path ------------------------------------
 
     def _build_tree_sparse(self, er, ef, eb, grad, hess, row_mask,
@@ -862,6 +1043,19 @@ def _node_reachable(is_leaf: np.ndarray, i: int) -> bool:
     return True
 
 
+def _densify_block(blk, f: int) -> np.ndarray:
+    """(n, f) f32 matrix of one RowBlock; features >= f are ignored
+    (unseen-at-train features, xgboost-like)."""
+    x = np.zeros((blk.size, f), np.float32)
+    vals = blk.values_or_ones()
+    for i in range(blk.size):
+        s, e = int(blk.offset[i]), int(blk.offset[i + 1])
+        ids = blk.index[s:e].astype(np.int64)
+        keep = ids < f
+        x[i, ids[keep]] = vals[s:e][keep]
+    return x
+
+
 def load_dense(uri: str, data_format: str = "libsvm",
                num_features: int = 0, part: int = 0, nparts: int = 1):
     """Densify a sparse text/rec uri to (x (n,F) f32, y (n,)) — GBDT bins a
@@ -878,14 +1072,95 @@ def load_dense(uri: str, data_format: str = "libsvm",
             f"feature id {blk.max_index()} too large to densify — GBDT "
             "bins a dense matrix; hash/remap the feature space first")
     f = num_features or blk.max_index() + 1
-    x = np.zeros((blk.size, f), np.float32)
-    vals = blk.values_or_ones()
-    for i in range(blk.size):
-        s, e = int(blk.offset[i]), int(blk.offset[i + 1])
-        ids = blk.index[s:e].astype(np.int64)
-        keep = ids < f  # unseen-at-train features are ignored (xgboost-like)
-        x[i, ids[keep]] = vals[s:e][keep]
-    return x, blk.label.copy()
+    return _densify_block(blk, f), blk.label.copy()
+
+
+class BinnedCache:
+    """On-disk cache of the binned (uint8) matrix in fixed-row chunks —
+    the ``#dtrain.cache`` analogue of the reference's external-memory
+    xgboost (``learn/xgboost/README.md:47-55``; the cache suffix appears
+    in ``mushroom.hadoop.conf:33``). Training streams it chunk by chunk,
+    so resident memory is one chunk plus the per-row vectors.
+
+    Layout: 24-byte header (magic, F u32, chunk_rows u32, total u64)
+    then row-major uint8 chunks back to back. Any registered filesystem
+    works (local, s3://, hdfs://)."""
+
+    MAGIC = b"WGBC\x01\x00\x00\x00"
+    _HDR = struct.Struct("<8sIIQ")
+
+    def __init__(self, path: str, num_features: int, chunk_rows: int,
+                 total: int = 0):
+        self.path = path
+        self.num_features = num_features
+        self.chunk_rows = chunk_rows
+        self.total = total
+
+    # -- writer --------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, num_features: int,
+               chunk_rows: int) -> "BinnedCache":
+        from wormhole_tpu.data.stream import open_stream
+        self = cls(path, num_features, chunk_rows)
+        self._f = open_stream(path, "wb")
+        self._f.write(self._HDR.pack(self.MAGIC, num_features, chunk_rows,
+                                     0))
+        self._fill = 0
+        self._buf = np.empty((chunk_rows, num_features), np.uint8)
+        return self
+
+    def append(self, bins: np.ndarray) -> None:
+        bins = np.ascontiguousarray(bins, np.uint8)
+        pos = 0
+        while pos < len(bins):
+            take = min(len(bins) - pos, self.chunk_rows - self._fill)
+            self._buf[self._fill:self._fill + take] = bins[pos:pos + take]
+            self._fill += take
+            pos += take
+            self.total += take
+            if self._fill == self.chunk_rows:
+                self._f.write(self._buf.tobytes())
+                self._fill = 0
+
+    def close(self) -> None:
+        if self._fill:
+            self._f.write(self._buf[:self._fill].tobytes())
+            self._fill = 0
+        self._f.seek(0)
+        self._f.write(self._HDR.pack(self.MAGIC, self.num_features,
+                                     self.chunk_rows, self.total))
+        self._f.close()
+
+    # -- reader --------------------------------------------------------
+
+    @classmethod
+    def open(cls, path: str) -> "BinnedCache":
+        from wormhole_tpu.data.stream import open_stream
+        with open_stream(path, "rb") as f:
+            magic, nf, cr, total = cls._HDR.unpack(f.read(cls._HDR.size))
+        if magic != cls.MAGIC:
+            raise ValueError(f"{path}: not a GBDT binned cache")
+        return cls(path, nf, cr, total)
+
+    @property
+    def num_chunks(self) -> int:
+        return -(-self.total // self.chunk_rows) if self.total else 0
+
+    def __iter__(self):
+        """Yield (row_offset, bins u8 (r, F)) — one chunk resident at a
+        time."""
+        from wormhole_tpu.data.stream import open_stream
+        F = self.num_features
+        with open_stream(self.path, "rb") as f:
+            f.seek(self._HDR.size)
+            for c in range(self.num_chunks):
+                lo = c * self.chunk_rows
+                rows = min(self.chunk_rows, self.total - lo)
+                raw = f.read(rows * F)
+                if len(raw) != rows * F:
+                    raise IOError(f"{self.path}: truncated chunk {c}")
+                yield lo, np.frombuffer(raw, np.uint8).reshape(rows, F)
 
 
 @dataclass
@@ -898,6 +1173,10 @@ class _GBDTCLI(GBDTConfig):
     num_features: int = 0
     sparse: bool = False   # CSR-entry path: O(nnz) memory, missing-aware
                            # splits (use for wide/hashed feature spaces)
+    external: bool = False  # external-memory mode: stream a binned
+                            # on-disk cache (xgboost #dtrain.cache)
+    cache: str = ""         # cache path (default: <data>.binned.cache)
+    chunk_rows: int = 1 << 16
 
 
 def main(argv=None) -> int:
@@ -928,6 +1207,20 @@ def main(argv=None) -> int:
             dv = load_sparse_binned(cli.val_data, cli.data_format,
                                     cli.num_bins, part, nparts, ref=data)
             log.info("val metrics: %s", model.evaluate_sparse(dv))
+    elif cli.external:
+        model.fit_external(cli.data, cli.data_format,
+                           chunk_rows=cli.chunk_rows,
+                           cache_path=cli.cache,
+                           num_features=cli.num_features,
+                           part=part, nparts=nparts)
+        log.info("train %s (last round): %.6f",
+                 "logloss" if cli.objective == "binary:logistic"
+                 else "mse", model.history[-1] if model.history else
+                 float("nan"))
+        if cli.val_data:
+            xv, yv = load_dense(cli.val_data, cli.data_format,
+                                len(model.cuts), part, nparts)
+            log.info("val metrics: %s", model.evaluate(xv, yv))
     else:
         x, y = load_dense(cli.data, cli.data_format, cli.num_features,
                           part, nparts)

@@ -164,6 +164,120 @@ class WideDeepStore(TableCheckpoint):
 
         return ev
 
+    # -- crec2 tile fast path ------------------------------------------------
+    #
+    # Binary features make the wide&deep forward a function of pooled
+    # per-row sums only: wide = Σ w[b], pooled_j = Σ v_j[b] — the same
+    # multi-channel tile pull as the FM path (1+k channels, one one-hot
+    # build shared). Backward: dual backprops through the MLP via vjp to
+    # d pooled (R, k); the embedding grads are plain channel pushes
+    # [dual, dpooled_1..k] plus a row-mask count channel for the exact
+    # touched-bucket set. (VERDICT r3 Missing #3.)
+
+    def _tile_step(self, info, kind: str):
+        key = (info, kind)
+        fn = getattr(self, "_tile_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.metrics import margin_hist
+        cfg = self.cfg
+        k = cfg.dim
+        n_layers = self.n_layers
+        objv_fn = self.objv_fn
+        _, dual_fn = create_loss(cfg.loss)
+        spec = info.spec
+        oc = info.ovf_cap
+
+        def decode(block):
+            lab_u8 = block["labels"]
+            row_mask = (lab_u8 != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab_u8, 1).astype(jnp.float32)
+            ovf_b = block["ovf_b"] if oc else None
+            ovf_r = block["ovf_r"] if oc else None
+            return block["pw"], labels, row_mask, ovf_b, ovf_r
+
+        def forward(s32, mlp, block):
+            pw, labels, row_mask, ovf_b, ovf_r = decode(block)
+            w, v = s32[:, 0], s32[:, 1:1 + k]
+            wpull = jnp.concatenate([w[:, None], v], axis=1)
+            pulls = tilemm.forward_pulls(pw, wpull, spec, ovf_b, ovf_r)
+            pooled = pulls[:, 1:]
+            deep_fn = lambda m, x: mlp_forward(m, x, n_layers)  # noqa: E731
+            deep, vjp = jax.vjp(deep_fn, mlp, pooled)
+            margin = pulls[:, 0] + deep
+            return (pw, labels, row_mask, ovf_b, ovf_r, pooled, vjp,
+                    margin)
+
+        if kind == "train":
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 4, 6))
+            def step(slots, mlp, accum, block, t, tau, macc):
+                s32 = slots.astype(jnp.float32)
+                theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
+                v = theta[:, 1:]
+                (pw, labels, row_mask, ovf_b, ovf_r, pooled, vjp,
+                 margin) = forward(s32, mlp, block)
+                objv = objv_fn(margin, labels, row_mask)
+                dual = dual_fn(margin, labels, row_mask)
+                g_mlp, g_pooled = vjp(dual)
+                dvals = jnp.concatenate(
+                    [dual[:, None], g_pooled, row_mask[:, None]], axis=1)
+                push = tilemm.backward_pushes(pw, dvals, spec,
+                                              ovf_b, ovf_r)
+                touched = push[:, 1 + k] > 0
+                g_v = push[:, 1:1 + k] + cfg.l2_v * v * touched[:, None]
+                grads = jnp.concatenate([push[:, :1], g_v], axis=1)
+                cg_new = jnp.where(touched[:, None],
+                                   jnp.sqrt(cg * cg + grads * grads), cg)
+                eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+                theta_new = jnp.where(touched[:, None],
+                                      theta - eta * grads, theta)
+                new = jnp.concatenate([theta_new, cg_new], axis=1)
+                accum = jax.tree.map(
+                    lambda a, g: jnp.sqrt(a * a + g * g), accum, g_mlp)
+                mlp_new = jax.tree.map(
+                    lambda p, g, a: p - cfg.lr_alpha_dense
+                    / (cfg.lr_beta + a) * g, mlp, g_mlp, accum)
+                num_ex = jnp.sum(row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                pos, neg = margin_hist(labels, margin, row_mask)
+                d0 = theta_new[:, 0] - theta[:, 0]
+                packed = jnp.concatenate([
+                    jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
+                    pos, neg])
+                return (new.astype(slots.dtype), mlp_new, accum, t + 1,
+                        macc + packed)
+        else:
+            @jax.jit
+            def step(slots, mlp, block):
+                s32 = slots.astype(jnp.float32)
+                (_, labels, row_mask, _, _, _, _,
+                 margin) = forward(s32, mlp, block)
+                objv = objv_fn(margin, labels, row_mask)
+                num_ex = jnp.sum(row_mask)
+                acc = accuracy(labels, margin, row_mask)
+                pos, neg = margin_hist(labels, margin, row_mask)
+                return objv, num_ex, acc, pos, neg, margin
+
+        if not hasattr(self, "_tile_cache"):
+            self._tile_cache = {}
+        self._tile_cache[key] = step
+        return step
+
+    def tile_train_step(self, block: dict, info, tau: float = 0.0):
+        """Fused crec2-block wide&deep step; metrics accumulate ON DEVICE
+        (fetch_metrics, same harvest pipeline as ShardedStore)."""
+        step = self._tile_step(info, "train")
+        (self.slots, self.mlp, self.mlp_accum, t_new,
+         self._macc) = step(self.slots, self.mlp, self.mlp_accum, block,
+                            self._t_device(), self._tau_const(tau),
+                            self._macc_buf())
+        self._advance_t(t_new)
+        return t_new
+
+    def tile_eval_step(self, block: dict, info):
+        return self._tile_step(info, "eval")(self.slots, self.mlp, block)
+
     # -- ShardedStore surface ------------------------------------------------
 
     def train_step(self, batch: SparseBatch, tau: float = 0.0):

@@ -385,6 +385,214 @@ def _build_bwd(spec: TileSpec):
     return bwd
 
 
+# ---------------------------------------------------------------------------
+# multi-channel kernels (FM / wide&deep embedding pulls and pushes)
+# ---------------------------------------------------------------------------
+#
+# The embedding-table generalization of the scalar kernels: CH per-bucket
+# values instead of one. Forward returns per-row SUMS over the row's pairs
+# for every channel (the pooled embedding Σ_p v[b_p, :] — FM's interaction
+# state and wide&deep's MLP input come from exactly this); backward
+# scatters per-(row,channel) values into per-(bucket,channel) sums.
+#
+# Channels ride contiguous 128-lane slices (channel-major: lane block j
+# holds channel j), so the expensive digit one-hots, the pair-word
+# relayout, and the transposed histogram lhs are built ONCE per
+# (group, tile) and reused by every channel — per-channel cost is pure
+# MXU (gather + pick + hist), the irreducible lanes-linear part.
+
+
+def _mask_where(cond: jax.Array, x: jax.Array) -> jax.Array:
+    """where(cond, x, 0) in bf16 — the digit compare is hoisted and
+    shared across channels (cond built once per (group, tile))."""
+    return jnp.where(cond, x, jnp.float32(0)).astype(jnp.bfloat16)
+
+
+def _fwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, w_ref, mg_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        mg_ref[:] = jnp.zeros_like(mg_ref)
+
+    S, GS, C, N = spec.subblocks, spec.group, spec.cap, spec.n
+    ones_pick = jnp.ones((B_LO, RL), jnp.bfloat16)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (N, 128), 1)
+    iota_rlo = jax.lax.broadcasted_iota(jnp.int32, (N, RL), 1)
+    for g in range(S // GS):
+        mgs = [[mg_ref[g * GS + j, jc] for jc in range(ch)]
+               for j in range(GS)]
+        for tb in range(spec.tiles_step):
+            pc = pw_ref[tb, g].astype(jnp.int32)           # (N,)
+            rep = pc[:, None]                              # ONE relayout
+            ohhi = _oh_rep(rep, HI_SH, HI_M, N, 128)       # pad -> 0 row
+            cond_lo = ((rep >> LO_SH) & LO_M) == iota_lo
+            cond_rlo = ((rep >> RLO_SH) & RLO_M) == iota_rlo
+            rhiTs = [_ohT_vec(pc[j * C:(j + 1) * C], RHI_SH, RHI_M,
+                              RH, C) for j in range(GS)]
+            for jc in range(ch):
+                wt = w_ref[tb, :, jc * B_LO:(jc + 1) * B_LO]
+                m = jnp.dot(ohhi, wt,
+                            preferred_element_type=jnp.float32)
+                wp = jnp.dot(_mask_where(cond_lo, m), ones_pick,
+                             preferred_element_type=jnp.float32)
+                rhs = _mask_where(cond_rlo, wp)            # (N, RL)
+                for j in range(GS):
+                    mgs[j][jc] += jnp.dot(
+                        rhiTs[j], rhs[j * C:(j + 1) * C],
+                        preferred_element_type=jnp.float32)
+        for j in range(GS):
+            for jc in range(ch):
+                mg_ref[g * GS + j, jc] = mgs[j][jc]
+
+
+def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
+    """dual_ref (S//bp, bp*RH, ch*RL): per-channel row grids on
+    contiguous lane blocks; same paired-subblock value chain as the
+    scalar bwd kernel, digit work hoisted out of the channel loop."""
+    S, GS, C = spec.subblocks, spec.group, spec.cap
+    bp = _bp(spec)
+    NC = bp * C
+    ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
+    offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
+    iota_ghi = jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+    iota_rlo = jax.lax.broadcasted_iota(jnp.int32, (NC, RL), 1)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (NC, 128), 1)
+    for tb in range(spec.tiles_step):
+        accs = [jnp.zeros((A_HI, B_LO), jnp.float32) for _ in range(ch)]
+        for g in range(S // GS):
+            for h in range(GS // bp):
+                sp = (g * GS) // bp + h
+                pc = pw_ref[tb, g, h * NC:(h + 1) * NC].astype(jnp.int32)
+                rep = pc[:, None]                          # one relayout
+                ohghi = ((((rep >> RHI_SH) & RHI_M) + offs)
+                         == iota_ghi).astype(jnp.bfloat16)
+                cond_rlo = ((rep >> RLO_SH) & RLO_M) == iota_rlo
+                cond_lo = ((rep >> LO_SH) & LO_M) == iota_lo
+                ohhiTs = [_ohT_vec(pc[j * C:(j + 1) * C], HI_SH, HI_M,
+                                   A_HI, C) for j in range(bp)]
+                for jc in range(ch):
+                    md = jnp.dot(ohghi,
+                                 dual_ref[sp, :, jc * RL:(jc + 1) * RL],
+                                 preferred_element_type=jnp.float32)
+                    dp = jnp.dot(_mask_where(cond_rlo, md), ones_bcast,
+                                 preferred_element_type=jnp.float32)
+                    rhs = _mask_where(cond_lo, dp)         # (NC, 128)
+                    for j in range(bp):
+                        accs[jc] += jnp.dot(
+                            ohhiTs[j], rhs[j * C:(j + 1) * C],
+                            preferred_element_type=jnp.float32)
+        for jc in range(ch):
+            g_ref[tb, jc] = accs[jc]
+
+
+def _multi_spec(spec: TileSpec, ch: int) -> TileSpec:
+    """Shrink tiles_step so the unrolled kernel body (~ tiles_step * ch
+    matmul chains) stays near the ch=1 compile budget — tiles_step=16 at
+    ch=10 measured a >10 min remote compile."""
+    import dataclasses
+    tb = max((t for t in (16, 8, 4, 2)
+              if spec.tiles % t == 0 and t * ch <= 32), default=1)
+    return dataclasses.replace(spec, tiles_step=tb)
+
+
+@lru_cache(maxsize=None)
+def _build_fwd_multi(spec: TileSpec, ch: int):
+    spec = _multi_spec(spec, ch)
+    T, TB = spec.tiles, spec.tiles_step
+    SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+
+    @jax.jit
+    def fwd(pw, w):
+        # (nb, ch) -> (T, A_HI, ch*B_LO): channel-major contiguous lanes
+        wt = (w.reshape(T, A_HI, B_LO, ch).transpose(0, 1, 3, 2)
+              .reshape(T, A_HI, ch * B_LO).astype(jnp.bfloat16))
+        mg = pl.pallas_call(
+            partial(_fwd_multi_kernel, spec, ch),
+            grid=(T // TB,),
+            in_specs=[
+                pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
+                pl.BlockSpec((TB, A_HI, ch * B_LO), lambda t: (t, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((S, ch, RH, RL), lambda t: (0, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((S, ch, RH, RL), jnp.float32),
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(pw, wt)
+        # (S, ch, RH, RL) -> (rows, ch)
+        return mg.transpose(0, 2, 3, 1).reshape(spec.block_rows, ch)
+
+    return fwd
+
+
+@lru_cache(maxsize=None)
+def _build_bwd_multi(spec: TileSpec, ch: int):
+    spec = _multi_spec(spec, ch)
+    T, TB = spec.tiles, spec.tiles_step
+    SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+    bp = _bp(spec)
+
+    @jax.jit
+    def bwd(pw, dual_rows):
+        # (rows, ch) -> (S//bp, bp*RH, ch*RL): channel-major lane blocks
+        dg = (dual_rows.reshape(S // bp, bp * RH, RL, ch)
+              .transpose(0, 1, 3, 2).reshape(S // bp, bp * RH, ch * RL)
+              .astype(jnp.bfloat16))
+        g = pl.pallas_call(
+            partial(_bwd_multi_kernel, spec, ch),
+            grid=(T // TB,),
+            in_specs=[
+                pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
+                pl.BlockSpec((S // bp, bp * RH, ch * RL),
+                             lambda t: (0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((TB, ch, A_HI, B_LO),
+                                   lambda t: (t, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((T, ch, A_HI, B_LO),
+                                           jnp.float32),
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(pw, dg)
+        # (T, ch, A_HI, B_LO) -> (nb, ch)
+        return g.transpose(0, 2, 3, 1).reshape(spec.nb, ch)
+
+    return bwd
+
+
+def forward_pulls(pw: jax.Array, w: jax.Array, spec: TileSpec,
+                  ovf_b: Optional[jax.Array] = None,
+                  ovf_r: Optional[jax.Array] = None) -> jax.Array:
+    """(block_rows, ch) per-row sums of w[bucket, :] over each row's
+    pairs — the pooled-embedding pull. w is (nb, ch) f32 (values round
+    through bf16 inside the kernel, like the scalar path)."""
+    ch = w.shape[1]
+    pulls = _build_fwd_multi(spec, ch)(pw, w)
+    if ovf_b is not None and ovf_b.shape[0]:
+        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+        idx = jnp.where(valid, ovf_b, 0).astype(jnp.int32)
+        wv = jnp.where(valid[:, None], w[idx], 0.0)
+        pulls = pulls.at[ovf_r.astype(jnp.int32) % spec.block_rows].add(wv)
+    return pulls
+
+
+def backward_pushes(pw: jax.Array, dual_rows: jax.Array, spec: TileSpec,
+                    ovf_b: Optional[jax.Array] = None,
+                    ovf_r: Optional[jax.Array] = None) -> jax.Array:
+    """(nb, ch) per-bucket sums of dual_rows[row, :] over the bucket's
+    pairs — the embedding-gradient push."""
+    ch = dual_rows.shape[1]
+    g = _build_bwd_multi(spec, ch)(pw, dual_rows)
+    if ovf_b is not None and ovf_b.shape[0]:
+        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+        d = jnp.where(valid[:, None],
+                      dual_rows[ovf_r.astype(jnp.int32) % spec.block_rows],
+                      0.0)
+        g = g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
+    return g
+
+
 # -- public jit-safe surface (call inside a jitted step) --------------------
 
 def forward_margins(pw: jax.Array, w: jax.Array,

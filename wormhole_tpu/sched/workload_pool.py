@@ -145,6 +145,8 @@ class WorkloadPool:
         return len(self._queue) + len(self._assigned)
 
     # -- straggler re-execution ---------------------------------------------
+    #
+    # (see also ReplicatedRounds below for the deterministic multihost form)
 
     def _requeue_stragglers(self) -> None:
         if not self._durations:
@@ -159,3 +161,93 @@ class WorkloadPool:
                          now - a.start, threshold)
                 a.is_rerun = True
                 self._queue.append(a.wl)
+
+
+class ReplicatedRounds:
+    """Deterministic straggler accounting for the REPLICATED multihost
+    pool (every process runs an identical pool; async_sgd.run_multihost).
+
+    The reference's straggler clock is wall time on one scheduler
+    (workload_pool.h:169-190). Replicated pools can't use wall clocks —
+    they desync across hosts — and in a lockstep SPMD round loop a slow
+    host can't fall behind in time anyway (it slows the shared collective
+    instead). What CAN diverge, and what re-execution can actually fix
+    here, is WORK imbalance: a part that takes many more lockstep rounds
+    than the mean. So this helper drives the pool's injectable ``time_fn``
+    with the global round counter: durations, the 3x-mean threshold, and
+    the requeue decision all happen in rounds, identically on every
+    replica.
+
+    It also tracks per-part progress (blocks contributed per round, from
+    the same allgathered status every replica sees), so a re-issued part
+    is claimed WITH a skip count: the new holder resumes exactly where
+    the original stopped and the original abandons — every block of the
+    part is processed exactly once, which the reference's run-both-copies
+    re-execution cannot guarantee.
+
+    Protocol (both multihost passes):
+      1. produce this round's blocks; count them in ``produced()``
+      2. allgather status rows ``[finished_id, need, drained, contributed]``
+      3. ``advance(status)`` — bump the round, credit per-part progress
+      4. process finishes (``finished(rank_pid)``)
+      5. process claims (``claimed(rank, wl)`` -> skip count; a claim of
+         a part another rank holds means that holder must ``abandon()``)
+    """
+
+    def __init__(self, pool: WorkloadPool, world: int, rank: int) -> None:
+        self.pool = pool
+        self.world = world
+        self.rank = rank
+        self.rounds = 0
+        pool._time = lambda: float(self.rounds)
+        self._progress: Dict[int, int] = {}    # part id -> blocks done
+        self._held: List[Optional[int]] = [None] * world
+        self._my_unreported = 0
+
+    def produced(self, nblocks: int) -> None:
+        """Count blocks THIS host dispatched since the last status row
+        (claim-round blocks ride the next row; by the time a part is old
+        enough to look like a straggler they are long since credited)."""
+        self._my_unreported += int(nblocks)
+
+    def status_row(self, finished_id: int, need: bool,
+                   drained: bool) -> "np.ndarray":
+        import numpy as np
+        row = np.asarray([finished_id, int(need), int(drained),
+                          self._my_unreported], np.int64)
+        self._my_unreported = 0
+        return row
+
+    def advance(self, status) -> None:
+        """One global round: credit each rank's contribution to the part
+        it held while producing (before this round's claims)."""
+        self.rounds += 1
+        for r in range(self.world):
+            pid = self._held[r]
+            if pid is not None:
+                self._progress[pid] = (self._progress.get(pid, 0)
+                                       + int(status[r, 3]))
+
+    def finished(self, pid: int) -> None:
+        self.pool.finish(pid)
+        self._progress.pop(pid, None)
+        for r in range(self.world):
+            if self._held[r] == pid:
+                self._held[r] = None
+
+    def claimed(self, r: int, wl: Workload) -> int:
+        """Record rank ``r`` claiming ``wl``; returns the block-skip
+        count the claimer must apply (0 for fresh parts)."""
+        skip = self._progress.get(wl.id, 0)
+        self._held[r] = wl.id
+        return skip
+
+    def reclaimed_from(self, wl: Workload, r: int) -> bool:
+        """True when rank ``r``'s claim of ``wl`` takes it over from this
+        host (straggler re-issue) — this host must abandon the part
+        (stop streaming it WITHOUT finishing; the new holder's finish
+        completes it)."""
+        return r != self.rank and self._held[self.rank] == wl.id
+
+    def abandon(self) -> None:
+        self._held[self.rank] = None

@@ -55,6 +55,16 @@ class Config:
     test_data: str = ""
     data_format: str = "libsvm"
     num_parts_per_file: int = 1
+    # straggler re-execution threshold (workload_pool.h FLAGS analogue):
+    # a part running straggler_factor x the mean completed-part duration
+    # is re-issued. Multihost passes measure duration in lockstep ROUNDS
+    # (deterministic across replicas); single-process in wall-clock.
+    straggler_factor: float = 3.0
+    # dense text fast path: binary-feature text formats (criteo/adfea)
+    # stream as natively-assembled in-memory crec blocks through the
+    # dense-apply device step instead of localize+pad in Python
+    text_dense: bool = True
+    text_block_rows: int = 16384
 
     # --- model ---
     model_in: str = ""

@@ -209,3 +209,68 @@ def test_wide_deep_crec2_end_to_end_learns(tmp_path, rng):
     prog = app.run()
     assert prog.num_ex == 20 * n
     assert prog.acc / max(prog.count, 1) > 0.7
+
+
+def test_fm_crec2_mesh_training_converges(tmp_path, rng):
+    """FM over crec2 on a data:2,model:2 mesh (the shard_map FM tile
+    step: model axis shards the embedding-table tiles, data axis shards
+    blocks): learns the planted XOR like the single-device path."""
+    from wormhole_tpu.data.crec import CRec2Writer
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    import jax
+    n = 6000
+    keys, _ = _make_rows(rng, n)
+    a = rng.random(n) < 0.5
+    b = rng.random(n) < 0.5
+    keys[:, 0] = np.where(a, 1111, 2222)
+    keys[:, 1] = np.where(b, 3333, 4444)
+    labels = (a ^ b).astype(np.uint8)
+    path = tmp_path / "fm_mesh.crec2"
+    with CRec2Writer(str(path), nnz=NNZ, nb=NB, subblocks=1) as w:
+        w.append(keys, labels)
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:2,model:2", jax.devices()[:4])
+    cfg = Config(train_data=str(path), data_format="crec2",
+                 num_buckets=NB, max_data_pass=15, disp_itv=1e12,
+                 max_delay=1)
+    store = FMStore(FMConfig(num_buckets=NB, dim=8, lr_alpha=0.3,
+                             seed=1), rt)
+    app = AsyncSGD(cfg, rt, store=store)
+    prog = app.run()
+    assert prog.num_ex == 15 * n
+    assert prog.acc / max(prog.count, 1) > 0.7
+
+
+def test_wide_deep_crec2_mesh_training_converges(tmp_path, rng):
+    """Wide&deep over crec2 on a data:2,model:2 mesh: sharded embedding
+    table, replicated MLP with data-psum'd gradients."""
+    from wormhole_tpu.data.crec import CRec2Writer
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    import jax
+    n = 6000
+    keys, _ = _make_rows(rng, n)
+    a = rng.random(n) < 0.5
+    b = rng.random(n) < 0.5
+    keys[:, 0] = np.where(a, 1111, 2222)
+    keys[:, 1] = np.where(b, 3333, 4444)
+    labels = (a ^ b).astype(np.uint8)
+    path = tmp_path / "wd_mesh.crec2"
+    with CRec2Writer(str(path), nnz=NNZ, nb=NB, subblocks=1) as w:
+        w.append(keys, labels)
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:2,model:2", jax.devices()[:4])
+    cfg = Config(train_data=str(path), data_format="crec2",
+                 num_buckets=NB, max_data_pass=20, disp_itv=1e12,
+                 max_delay=1)
+    store = WideDeepStore(WideDeepConfig(
+        num_buckets=NB, dim=8, hidden=(32,), lr_alpha=0.3,
+        lr_alpha_dense=0.1, init_scale=0.1, seed=1), rt)
+    app = AsyncSGD(cfg, rt, store=store)
+    prog = app.run()
+    assert prog.num_ex == 20 * n
+    assert prog.acc / max(prog.count, 1) > 0.7

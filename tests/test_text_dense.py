@@ -121,3 +121,33 @@ def test_text_dense_on_mesh(tmp_path, rng):
     prog = app.run()
     assert prog.num_ex == 6 * n
     assert prog.acc / max(prog.count, 1) > 0.8
+
+
+def test_adfea_dense_path(tmp_path, rng):
+    """adfea (the other binary text format) through the dense fast path:
+    needs max_nnz as its fixed row width; rows account exactly."""
+    import jax
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    n = 1200
+    lines = []
+    for i in range(n):
+        y = int(rng.random() < 0.5)
+        feats = rng.choice(100000, size=5, replace=False)
+        feats[0] = 7 if y else 8
+        toks = " ".join(f"{f}:1" for f in feats)
+        # adfea rows: lineid, feature count, label, then feat:group pairs
+        lines.append(f"{i} {len(feats)} {y} {toks}")
+    src = tmp_path / "t.adfea"
+    src.write_text("\n".join(lines) + "\n")
+    cfg = Config(train_data=str(src), data_format="adfea",
+                 num_buckets=1 << 16, lr_eta=0.3, max_data_pass=4,
+                 disp_itv=1e12, max_delay=1, max_nnz=8,
+                 text_block_rows=512)
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:1", jax.devices()[:1])
+    app = AsyncSGD(cfg, rt)
+    prog = app.run()
+    assert prog.num_ex == 4 * n
+    assert prog.acc / max(prog.count, 1) > 0.8

@@ -86,3 +86,56 @@ def test_finished_part_not_reassigned(tmp_path):
     pool.finish(b.id)  # original finishes first
     assert pool.get("y") is None  # the copy must not be handed out again
     assert pool.is_finished()
+
+
+def test_replicated_rounds_exact_skip_handoff():
+    """ReplicatedRounds unit semantics (the deterministic straggler
+    machinery driving run_multihost): rounds-based durations, 3x-mean
+    re-issue, exact block-skip for the new holder, abandon for the old —
+    simulated from one replica's view with two hosts."""
+    import numpy as np
+    from wormhole_tpu.sched.workload_pool import (ReplicatedRounds,
+                                                  Workload, WorkloadPool)
+    pool = WorkloadPool(straggler_factor=3.0)
+    rr = ReplicatedRounds(pool, world=2, rank=0)
+    # two parts: host0 claims the big one (24 blocks), host1 the small
+    # one (3 blocks); 1 block per host per round
+    pool._queue = [Workload("big", 0, 1, id=0), Workload("small", 0, 1,
+                                                         id=1)]
+    pool._next_id = 2
+
+    def round_status(c0, f0, n0, c1, f1, n1):
+        return np.asarray([[f0, n0, 0, c0], [f1, n1, 0, c1]], np.int64)
+
+    # round 0: both claim
+    rr.advance(round_status(0, -1, 1, 0, -1, 1))
+    w0 = pool.get("proc0")
+    assert rr.claimed(0, w0) == 0 and w0.id == 0
+    w1 = pool.get("proc1")
+    assert rr.claimed(1, w1) == 0 and w1.id == 1
+    # rounds 1..3: both produce one block per round; host1 finishes its
+    # 3-block part at round 3 (reported at round 4)
+    for _ in range(3):
+        rr.advance(round_status(1, -1, 0, 1, -1, 0))
+    rr.advance(round_status(1, 1, 1, 0, -1, 1))   # h1 finished, needy
+    rr.finished(1)
+    assert pool.get("proc1") is None              # queue drained
+    # mean duration = 4 rounds -> threshold 12; host0 keeps grinding
+    for _ in range(8):
+        rr.advance(round_status(1, -1, 0, 0, -1, 1))
+        assert pool.get("proc1") is None or False  # not yet a straggler
+    # a few more rounds past the threshold
+    for _ in range(2):
+        rr.advance(round_status(1, -1, 0, 0, -1, 1))
+    wl = pool.get("proc1")                        # straggler re-issued
+    assert wl is not None and wl.id == 0
+    # host0 contributed 1 block in rounds 1..14 = 14 blocks so far
+    skip = rr.claimed(1, wl)
+    assert skip == 14, skip
+    # rank 0 (the original holder) must abandon
+    assert rr.reclaimed_from(wl, 1)
+    rr.abandon()
+    assert rr._held[0] is None and rr._held[1] == 0
+    # the new holder finishes; the pool closes the part exactly once
+    rr.finished(0)
+    assert pool.is_finished()

@@ -445,11 +445,13 @@ class AsyncSGD:
 
         has_mesh_step = hasattr(
             self.store, "tile_train_step_mesh" if fmt == "crec2"
-            else "dense_train_step_mesh")  # text rides the dense step
+            else "dense_train_step_mesh") \
+            and getattr(self.store, "rt", None) is not None
+        # text formats ride the dense mesh step; the linear, FM and
+        # wide&deep stores all provide mesh steps — a custom store
+        # without one (or built without a runtime) falls through to the
+        # single-device tile path on its own placement
         if self.rt.mesh.size > 1 and has_mesh_step:
-            # stores without a mesh step (FM / wide&deep embedding
-            # tables) run the single-device tile path on their own
-            # placement
             return self._process_crec_mesh(file, part, nparts, kind,
                                            pooled, info, local, fmt)
         pfx = "" if kind == TRAIN else "eval_"

@@ -144,6 +144,56 @@ def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
     return q * (scale / levels)
 
 
+# -- shared mesh-step machinery (used by the linear, FM and wide&deep
+#    mesh tile steps and the dense mesh step) ------------------------------
+
+def mesh_tile_geometry(rt, spec):
+    """(nb_local, spec_local, have_model) for a model-axis-sharded tile
+    step: each shard runs the tile kernels over its own tile range."""
+    from wormhole_tpu.ops import tilemm
+    m = rt.model_axis_size
+    if spec.nb % (tilemm.TILE * m):
+        raise ValueError(f"nb {spec.nb} not shardable over model axis {m}")
+    nb_local = spec.nb // m
+    spec_local = tilemm.make_spec(nb_local, spec.subblocks, spec.cap)
+    have_model = m > 1 and MODEL_AXIS in rt.mesh.axis_names
+    return nb_local, spec_local, have_model
+
+
+def shard_range_mask(ovb, off, nb_local):
+    """(valid, local_idx) of overflow COO buckets owned by this model
+    shard: the 0xFFFFFFFF pad sentinel and out-of-range buckets mask
+    out; idx is clamped to 0 where invalid (callers zero the values)."""
+    bi = ovb.astype(jnp.int32)
+    valid = ((ovb != jnp.uint32(0xFFFFFFFF))
+             & (bi >= off) & (bi < off + nb_local))
+    return valid, jnp.where(valid, bi - off, 0)
+
+
+def mesh_metric_sums(objv, num_ex, acc, pos, neg):
+    """DATA-axis metric reduction shared by every mesh step: returns
+    (objv_g, tot_ex, acc_frac, pos_g, neg_g). acc is a per-shard
+    FRACTION; a plain psum would sum D fractions while the harvest
+    credits count += 1 per grouped step, so each shard's fraction is
+    weighted by its row count (PAD shards contribute 0 rows) and the
+    psum'd value is the exact fraction of the grouped step — acc/count
+    stays a mean over steps on any mesh geometry."""
+    from wormhole_tpu.parallel.mesh import DATA_AXIS
+    tot_ex = jax.lax.psum(num_ex, DATA_AXIS)
+    acc_frac = (jax.lax.psum(acc * num_ex, DATA_AXIS)
+                / jnp.maximum(tot_ex, 1.0))
+    return (jax.lax.psum(objv, DATA_AXIS), tot_ex, acc_frac,
+            jax.lax.psum(pos, DATA_AXIS), jax.lax.psum(neg, DATA_AXIS))
+
+
+def mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2, pos_g, neg_g):
+    """The packed on-device metric row every mesh train step
+    accumulates: [objv, num_ex, acc, wdelta2, pos[bins], neg[bins]]
+    (TableCheckpoint.MACC_LEN layout, consumed by _harvest_macc)."""
+    return jnp.concatenate([
+        jnp.stack([objv_g, tot_ex, acc_frac, wdelta2]), pos_g, neg_g])
+
+
 @dataclass
 class StoreConfig:
     num_buckets: int = 1 << 20
@@ -445,14 +495,10 @@ class ShardedStore(TableCheckpoint):
             num_ex = jnp.sum(row_mask)
             acc = accuracy(labels, margin, row_mask)
             pos, neg = margin_hist(labels, margin, row_mask)
-            tot_ex = jax.lax.psum(num_ex, DATA_AXIS)
-            acc_frac = (jax.lax.psum(acc * num_ex, DATA_AXIS)
-                        / jnp.maximum(tot_ex, 1.0))
+            objv_g, tot_ex, acc_frac, pos_g, neg_g = mesh_metric_sums(
+                objv, num_ex, acc, pos, neg)
             if kind == "eval":
-                pos = jax.lax.psum(pos, DATA_AXIS)
-                neg = jax.lax.psum(neg, DATA_AXIS)
-                return (jax.lax.psum(objv, DATA_AXIS), tot_ex, acc_frac,
-                        pos, neg, margin)
+                return objv_g, tot_ex, acc_frac, pos_g, neg_g, margin
             dual = dual_fn(margin, labels, row_mask)
             if not exact_dense:
                 dual = _nudge_zero_dual(dual, labels, row_mask)
@@ -465,11 +511,8 @@ class ShardedStore(TableCheckpoint):
             wdelta2 = jnp.sum(d0 * d0)
             if have_model:
                 wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
-            packed_m = jnp.concatenate([
-                jnp.stack([jax.lax.psum(objv, DATA_AXIS),
-                           tot_ex, acc_frac, wdelta2]),
-                jax.lax.psum(pos, DATA_AXIS),
-                jax.lax.psum(neg, DATA_AXIS)])
+            packed_m = mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2,
+                                     pos_g, neg_g)
             return new.astype(slots_l.dtype), t + 1, macc + packed_m
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
@@ -610,16 +653,10 @@ class ShardedStore(TableCheckpoint):
         from wormhole_tpu.parallel.mesh import DATA_AXIS
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         mesh = self.rt.mesh
-        dpa = self.rt.data_axis_size
-        m = self.rt.model_axis_size
         spec = info.spec
-        if spec.nb % (tilemm.TILE * m):
-            raise ValueError(
-                f"nb {spec.nb} not shardable over model axis {m}")
-        nb_local = spec.nb // m
-        spec_local = tilemm.make_spec(nb_local, spec.subblocks, spec.cap)
+        nb_local, spec_local, have_model = mesh_tile_geometry(self.rt,
+                                                              spec)
         oc, R = info.ovf_cap, info.block_rows
-        have_model = m > 1 and MODEL_AXIS in mesh.axis_names
 
         def body(slots_l, pw_l, lab_l, ovb_l, ovr_l, t, tau, macc):
             pw1 = pw_l[0].reshape(spec_local.pairs_shape)
@@ -633,12 +670,7 @@ class ShardedStore(TableCheckpoint):
                    if have_model else 0)
             if oc:
                 ovb, ovr = ovb_l[0], ovr_l[0]
-                # int32 is enough: bucket ids < nb <= 2^26; the 0xFFFFFFFF
-                # sentinel wraps to -1, already excluded by the mask below
-                bi = ovb.astype(jnp.int32)
-                valid = ((ovb != jnp.uint32(0xFFFFFFFF))
-                         & (bi >= off) & (bi < off + nb_local))
-                idx = jnp.where(valid, bi - off, 0).astype(jnp.int32)
+                valid, idx = shard_range_mask(ovb, off, nb_local)
                 wv = jnp.where(valid, w[idx], 0.0)
                 mg = mg.at[ovr.astype(jnp.int32)].add(wv)
             margin = (jax.lax.psum(mg, MODEL_AXIS) if have_model else mg)
@@ -646,20 +678,10 @@ class ShardedStore(TableCheckpoint):
             num_ex = jnp.sum(row_mask)
             acc = accuracy(labels, margin, row_mask)
             pos, neg = margin_hist(labels, margin, row_mask)
-            # acc is a per-shard *fraction*; a plain psum over DATA would
-            # sum D fractions while the harvest credits count += 1 per
-            # grouped step. Weight each shard by its row count (PAD shards
-            # contribute 0 rows) so the psum'd value is the exact fraction
-            # of the grouped step — acc/count stays a mean over steps on
-            # any mesh geometry.
-            tot_ex = jax.lax.psum(num_ex, DATA_AXIS)
-            acc_frac = (jax.lax.psum(acc * num_ex, DATA_AXIS)
-                        / jnp.maximum(tot_ex, 1.0))
+            objv_g, tot_ex, acc_frac, pos_g, neg_g = mesh_metric_sums(
+                objv, num_ex, acc, pos, neg)
             if kind == "eval":
-                pos = jax.lax.psum(pos, DATA_AXIS)
-                neg = jax.lax.psum(neg, DATA_AXIS)
-                return (jax.lax.psum(objv, DATA_AXIS), tot_ex, acc_frac,
-                        pos, neg, margin)
+                return objv_g, tot_ex, acc_frac, pos_g, neg_g, margin
             dual = dual_fn(margin, labels, row_mask)
             if not exact_dense:
                 dual = _nudge_zero_dual(dual, labels, row_mask)
@@ -674,11 +696,8 @@ class ShardedStore(TableCheckpoint):
             wdelta2 = jnp.sum(d0 * d0)
             if have_model:
                 wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
-            packed = jnp.concatenate([
-                jnp.stack([jax.lax.psum(objv, DATA_AXIS),
-                           tot_ex, acc_frac, wdelta2]),
-                jax.lax.psum(pos, DATA_AXIS),
-                jax.lax.psum(neg, DATA_AXIS)])
+            packed = mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2,
+                                   pos_g, neg_g)
             return new.astype(slots_l.dtype), t + 1, macc + packed
 
         Pm = P(MODEL_AXIS, None) if have_model else P(None, None)

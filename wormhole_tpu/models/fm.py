@@ -242,6 +242,146 @@ class FMStore(TableCheckpoint):
         self._tile_cache[key] = step
         return step
 
+    def _tile_step_mesh(self, info, kind: str):
+        """The distributed form of the FM tile path, with the same mesh
+        geometry as ShardedStore's: the MODEL axis shards the bucket
+        tiles (each shard pulls/pushes its own tile range with a local
+        TileSpec), the DATA axis shards whole blocks; pooled pulls psum
+        over model, channel pushes psum over data, the AdaGrad update
+        applies shard-locally."""
+        key = (info, kind, "mesh")
+        fn = getattr(self, "_tile_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        from jax import shard_map
+        from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.metrics import accuracy, margin_hist
+        from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+        cfg = self.cfg
+        k = cfg.dim
+        objv_fn, dual_fn = self.objv_fn, self.dual_fn
+        penalty = L1L2(cfg.l1, cfg.l2)
+        from wormhole_tpu.learners.store import (mesh_macc_row,
+                                                 mesh_metric_sums,
+                                                 mesh_tile_geometry,
+                                                 shard_range_mask)
+        mesh = self.rt.mesh
+        spec = info.spec
+        nb_local, spec_local, have_model = mesh_tile_geometry(self.rt,
+                                                              spec)
+        oc, R = info.ovf_cap, info.block_rows
+
+        def body(slots_l, pw_l, lab_l, ovb_l, ovr_l, t, tau, macc):
+            pw1 = pw_l[0].reshape(spec_local.pairs_shape)
+            lab = lab_l[0]
+            row_mask = (lab != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab, 1).astype(jnp.float32)
+            s32 = slots_l.astype(jnp.float32)
+            theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
+            w, v = theta[:, 0], theta[:, 1:]
+            wpull = jnp.concatenate(
+                [w[:, None], v, jnp.sum(v * v, 1, keepdims=True)], axis=1)
+            pulls = tilemm.forward_pulls(pw1, wpull, spec_local)
+            off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
+                   if have_model else 0)
+            if oc:
+                ovb, ovr = ovb_l[0], ovr_l[0]
+                valid, idx = shard_range_mask(ovb, off, nb_local)
+                wv = jnp.where(valid[:, None], wpull[idx], 0.0)
+                pulls = pulls.at[ovr.astype(jnp.int32) % R].add(wv)
+            pulls = (jax.lax.psum(pulls, MODEL_AXIS) if have_model
+                     else pulls)
+            s = pulls[:, 1:1 + k]
+            margin = (pulls[:, 0]
+                      + 0.5 * (jnp.sum(s * s, axis=1) - pulls[:, 1 + k]))
+            objv = objv_fn(margin, labels, row_mask)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            objv_g, tot_ex, acc_frac, pos_g, neg_g = mesh_metric_sums(
+                objv, num_ex, acc, pos, neg)
+            if kind == "eval":
+                return objv_g, tot_ex, acc_frac, pos_g, neg_g, margin
+            dual = dual_fn(margin, labels, row_mask)
+            dvals = jnp.concatenate(
+                [dual[:, None], dual[:, None] * s, row_mask[:, None]],
+                axis=1)
+            push = tilemm.backward_pushes(pw1, dvals, spec_local)
+            if oc:
+                dv = jnp.where(valid[:, None],
+                               dvals[ovr.astype(jnp.int32) % R], 0.0)
+                push = push.at[idx].add(dv)
+            push = jax.lax.psum(push, DATA_AXIS)
+            g_w = push[:, 0]
+            touched = push[:, 1 + k] > 0
+            g_v = push[:, 1:1 + k] - v * g_w[:, None] \
+                + cfg.l2_v * v * touched[:, None]
+            grads = jnp.concatenate([g_w[:, None], g_v], axis=1)
+            cg_new = jnp.where(touched[:, None],
+                               jnp.sqrt(cg * cg + grads * grads), cg)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            w_new = penalty.solve(w / eta[:, 0] - g_w, 1.0 / eta[:, 0])
+            v_new = v - eta[:, 1:] * g_v
+            theta_new = jnp.where(
+                touched[:, None],
+                jnp.concatenate([w_new[:, None], v_new], axis=1), theta)
+            new = jnp.concatenate([theta_new, cg_new], axis=1)
+            d0 = theta_new[:, 0] - w
+            wdelta2 = jnp.sum(d0 * d0)
+            if have_model:
+                wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
+            packed = mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2,
+                                   pos_g, neg_g)
+            return new.astype(slots_l.dtype), t + 1, macc + packed
+
+        from jax.sharding import PartitionSpec as P
+        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
+                else P(DATA_AXIS, None, None, None))
+        data_specs = (Pm, Pblk, P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(DATA_AXIS, None))
+        if kind == "train":
+            in_specs = data_specs + (P(), P(), P())
+            out_specs = (Pm, P(), P())
+            fn = body
+        else:
+            in_specs = data_specs
+            out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
+
+            def fn(s, pw_, lab_, ovb_, ovr_):
+                return body(s, pw_, lab_, ovb_, ovr_, jnp.float32(0),
+                            jnp.float32(0), jnp.float32(0))
+        step = jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False),
+            donate_argnums=(0, 5, 7) if kind == "train" else ())
+        if not hasattr(self, "_tile_cache"):
+            self._tile_cache = {}
+        self._tile_cache[key] = step
+        return step
+
+    def tile_train_step_mesh(self, blocks: dict, info, tau: float = 0.0):
+        """Mesh FM tile step over ``data_axis_size`` blocks stacked on a
+        leading axis (same calling convention as ShardedStore's)."""
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        step = self._tile_step_mesh(info, "train")
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        self.slots, t_new, self._macc = step(
+            self.slots, blocks["pw"], blocks["labels"],
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z),
+            self._t_device(), self._tau_const(tau), self._macc_buf())
+        self._advance_t(t_new)
+        return t_new
+
+    def tile_eval_step_mesh(self, blocks: dict, info):
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        return self._tile_step_mesh(info, "eval")(
+            self.slots, blocks["pw"], blocks["labels"],
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z))
+
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block FM step; metrics accumulate ON DEVICE
         (fetch_metrics, same harvest pipeline as ShardedStore)."""

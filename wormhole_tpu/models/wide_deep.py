@@ -264,6 +264,158 @@ class WideDeepStore(TableCheckpoint):
         self._tile_cache[key] = step
         return step
 
+    def _tile_step_mesh(self, info, kind: str):
+        """Distributed wide&deep tile step (same mesh geometry as the FM
+        and linear stores): the MODEL axis shards the embedding-table
+        tiles, the DATA axis shards blocks; pooled pulls psum over
+        model, channel pushes and MLP gradients psum over data, the MLP
+        parameters stay replicated."""
+        key = (info, kind, "mesh")
+        fn = getattr(self, "_tile_cache", {}).get(key)
+        if fn is not None:
+            return fn
+        from jax import shard_map
+        from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.metrics import margin_hist
+        from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+        cfg = self.cfg
+        k = cfg.dim
+        n_layers = self.n_layers
+        objv_fn = self.objv_fn
+        _, dual_fn = create_loss(cfg.loss)
+        from wormhole_tpu.learners.store import (mesh_macc_row,
+                                                 mesh_metric_sums,
+                                                 mesh_tile_geometry,
+                                                 shard_range_mask)
+        mesh = self.rt.mesh
+        spec = info.spec
+        nb_local, spec_local, have_model = mesh_tile_geometry(self.rt,
+                                                              spec)
+        oc, R = info.ovf_cap, info.block_rows
+
+        def body(slots_l, mlp, accum, pw_l, lab_l, ovb_l, ovr_l, t, tau,
+                 macc):
+            pw1 = pw_l[0].reshape(spec_local.pairs_shape)
+            lab = lab_l[0]
+            row_mask = (lab != jnp.uint8(255)).astype(jnp.float32)
+            labels = jnp.minimum(lab, 1).astype(jnp.float32)
+            s32 = slots_l.astype(jnp.float32)
+            theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
+            v = theta[:, 1:]
+            wpull = jnp.concatenate([theta[:, :1], v], axis=1)
+            pulls = tilemm.forward_pulls(pw1, wpull, spec_local)
+            off = (jax.lax.axis_index(MODEL_AXIS) * nb_local
+                   if have_model else 0)
+            if oc:
+                ovb, ovr = ovb_l[0], ovr_l[0]
+                valid, idx = shard_range_mask(ovb, off, nb_local)
+                wv = jnp.where(valid[:, None], wpull[idx], 0.0)
+                pulls = pulls.at[ovr.astype(jnp.int32) % R].add(wv)
+            pulls = (jax.lax.psum(pulls, MODEL_AXIS) if have_model
+                     else pulls)
+            pooled = pulls[:, 1:]
+            deep_fn = lambda mm, x: mlp_forward(mm, x, n_layers)  # noqa
+            deep, vjp = jax.vjp(deep_fn, mlp, pooled)
+            margin = pulls[:, 0] + deep
+            objv = objv_fn(margin, labels, row_mask)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            objv_g, tot_ex, acc_frac, pos_g, neg_g = mesh_metric_sums(
+                objv, num_ex, acc, pos, neg)
+            if kind == "eval":
+                return objv_g, tot_ex, acc_frac, pos_g, neg_g, margin
+            dual = dual_fn(margin, labels, row_mask)
+            g_mlp, g_pooled = vjp(dual)
+            # MLP params are replicated; their per-shard gradients cover
+            # only the shard's rows — sum over the data axis
+            g_mlp = jax.tree.map(lambda g: jax.lax.psum(g, DATA_AXIS),
+                                 g_mlp)
+            dvals = jnp.concatenate(
+                [dual[:, None], g_pooled, row_mask[:, None]], axis=1)
+            push = tilemm.backward_pushes(pw1, dvals, spec_local)
+            if oc:
+                dv = jnp.where(valid[:, None],
+                               dvals[ovr.astype(jnp.int32) % R], 0.0)
+                push = push.at[idx].add(dv)
+            push = jax.lax.psum(push, DATA_AXIS)
+            touched = push[:, 1 + k] > 0
+            g_v = push[:, 1:1 + k] + cfg.l2_v * v * touched[:, None]
+            grads = jnp.concatenate([push[:, :1], g_v], axis=1)
+            cg_new = jnp.where(touched[:, None],
+                               jnp.sqrt(cg * cg + grads * grads), cg)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            theta_new = jnp.where(touched[:, None], theta - eta * grads,
+                                  theta)
+            new = jnp.concatenate([theta_new, cg_new], axis=1)
+            accum = jax.tree.map(
+                lambda a, g: jnp.sqrt(a * a + g * g), accum, g_mlp)
+            mlp_new = jax.tree.map(
+                lambda p, g, a: p - cfg.lr_alpha_dense
+                / (cfg.lr_beta + a) * g, mlp, g_mlp, accum)
+            d0 = theta_new[:, 0] - theta[:, 0]
+            wdelta2 = jnp.sum(d0 * d0)
+            if have_model:
+                wdelta2 = jax.lax.psum(wdelta2, MODEL_AXIS)
+            packed = mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2,
+                                   pos_g, neg_g)
+            return (new.astype(slots_l.dtype), mlp_new, accum, t + 1,
+                    macc + packed)
+
+        from jax.sharding import PartitionSpec as P
+        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
+                else P(DATA_AXIS, None, None, None))
+        Pmlp = jax.tree.map(lambda _: P(), self.mlp)
+        data_specs = (Pm, Pmlp, Pmlp, Pblk, P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(DATA_AXIS, None))
+        if kind == "train":
+            in_specs = data_specs + (P(), P(), P())
+            out_specs = (Pm, Pmlp, Pmlp, P(), P())
+            fn = body
+        else:
+            in_specs = data_specs
+
+            def fn(s, mm, aa, pw_, lab_, ovb_, ovr_):
+                return body(s, mm, aa, pw_, lab_, ovb_, ovr_,
+                            jnp.float32(0), jnp.float32(0),
+                            jnp.float32(0))
+            out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
+        step = jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False),
+            donate_argnums=(0, 1, 2, 7, 9) if kind == "train" else ())
+        if not hasattr(self, "_tile_cache"):
+            self._tile_cache = {}
+        self._tile_cache[key] = step
+        return step
+
+    def tile_train_step_mesh(self, blocks: dict, info, tau: float = 0.0):
+        """Mesh wide&deep tile step over ``data_axis_size`` blocks
+        stacked on a leading axis (ShardedStore calling convention)."""
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        step = self._tile_step_mesh(info, "train")
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        (self.slots, self.mlp, self.mlp_accum, t_new,
+         self._macc) = step(self.slots, self.mlp, self.mlp_accum,
+                            blocks["pw"], blocks["labels"],
+                            blocks.get("ovf_b", z),
+                            blocks.get("ovf_r", z),
+                            self._t_device(), self._tau_const(tau),
+                            self._macc_buf())
+        self._advance_t(t_new)
+        return t_new
+
+    def tile_eval_step_mesh(self, blocks: dict, info):
+        oc = info.ovf_cap
+        D = self.rt.data_axis_size
+        z = np.zeros((D, max(oc, 1)), np.uint32)
+        return self._tile_step_mesh(info, "eval")(
+            self.slots, self.mlp, self.mlp_accum, blocks["pw"],
+            blocks["labels"], blocks.get("ovf_b", z),
+            blocks.get("ovf_r", z))
+
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block wide&deep step; metrics accumulate ON DEVICE
         (fetch_metrics, same harvest pipeline as ShardedStore)."""

@@ -3,6 +3,7 @@ DCN code path the reference exercises with dmlc_local.py multi-process
 runs, SURVEY.md §4.3)."""
 
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -11,6 +12,16 @@ import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_num_ex(out: str):
+    """Line-anchored per-rank ``num_ex`` parse (the launcher merges rank
+    output line-atomically; anchoring makes the parse robust even if a
+    rank's line is preceded by other output)."""
+    vals = [int(m) for m in
+            re.findall(r"^OK rank \d+ num_ex=(\d+)", out, re.M)]
+    assert vals, f"no 'OK rank N num_ex=' line in:\n{out}"
+    return vals
 
 
 def run_mp(n: int, body: str, timeout=240, launcher_args=(),
@@ -148,7 +159,7 @@ def test_mp_async_restart_resumes(tmp_path):
     # training only 2 more passes (num_ex counts post-resume rows)
     out2 = run_mp(2, body.replace("MAXPASS", "4"), timeout=420)
     assert out2.count("OK rank") == 2
-    num_ex = int(out2.split("num_ex=")[1].split()[0])
+    num_ex = parse_num_ex(out2)[0]
     # only passes 2 and 3 ran — the job resumed from the v2 checkpoint
     assert num_ex == 2 * 200, out2
 
@@ -243,6 +254,72 @@ def test_mp_gbdt_matches_single_process(tmp_path):
     assert auc_mp > 0.9, out
 
 
+def test_mp_gbdt_sparse_matches_single_process(tmp_path):
+    """dsplit=row SPARSE GBDT (closes VERDICT r4 Missing #1): each process
+    loads its CSR shard of a wide libsvm file, feature ids and quantile
+    cuts are agreed globally (_global_sparse_sketch), and the per-level
+    histogram allreduce makes both ranks build the same trees as a
+    single-process fit over all rows — without any (rows, F)
+    densification (reference: distributed xgboost on sparse libsvm,
+    learn/xgboost/README.md:35-44)."""
+    rng = np.random.default_rng(13)
+    n, dim = 600, 500
+    lines = []
+    for _ in range(n):
+        y = rng.random() < 0.5
+        feats = np.sort(rng.choice(np.arange(2, dim), size=12,
+                                   replace=False))
+        vals = np.round(rng.standard_normal(12), 3)
+        planted = 0 if y else 1
+        toks = [f"{planted}:1"] + [f"{j}:{v}" for j, v in zip(feats, vals)]
+        lines.append(f"{int(y)} " + " ".join(toks))
+    p = tmp_path / "wide.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    out = run_mp(2, f"""
+        import numpy as np
+        from wormhole_tpu.models.gbdt import (GBDT, GBDTConfig,
+                                              load_sparse_binned)
+        from wormhole_tpu.parallel.mesh import MeshRuntime
+        rt = MeshRuntime.create()
+        part, nparts = rt.local_part()
+        data = load_sparse_binned({str(p)!r}, "libsvm", 16,
+                                  part, nparts, runtime=rt)
+        model = GBDT(GBDTConfig(num_round=4, max_depth=3, num_bins=16),
+                     rt)
+        model.fit_sparse(data)
+        feats = np.concatenate([np.asarray(t.feature)
+                                for t in model.trees])
+        sbs = np.concatenate([np.asarray(t.split_bin)
+                              for t in model.trees])
+        mets = model.evaluate_sparse(data)
+        print(f"OK rank {{rt.rank}} trees="
+              f"{{feats.tolist()}}|{{sbs.tolist()}} "
+              f"auc={{mets['auc']:.6f}}")
+    """, timeout=420)
+    assert out.count("OK rank") == 2
+    rows = [ln for ln in out.splitlines() if "trees=" in ln]
+    # both ranks agreed on cuts, hists, and therefore trees
+    assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
+    # single-process oracle over ALL rows
+    from wormhole_tpu.models.gbdt import GBDT, GBDTConfig, \
+        load_sparse_binned
+    data = load_sparse_binned(str(p), "libsvm", 16)
+    solo = GBDT(GBDTConfig(num_round=4, max_depth=3, num_bins=16))
+    solo.fit_sparse(data)
+    feats = np.concatenate([np.asarray(t.feature) for t in solo.trees])
+    sbs = np.concatenate([np.asarray(t.split_bin) for t in solo.trees])
+    got_f, got_s = rows[0].split("trees=")[1].split(" auc=")[0].split("|")
+    same = (np.array_equal(np.asarray(eval(got_f)), feats)
+            and np.array_equal(np.asarray(eval(got_s)), sbs))
+    auc_mp = float(rows[0].split("auc=")[1].split()[0])
+    if not same:
+        # f32 histogram partial-sum order differs between the sharded
+        # solo scatter and the 2-host allreduce; near-tie gains may flip
+        frac = np.mean(np.asarray(eval(got_f)) == feats)
+        assert frac > 0.9, (frac, out)
+    assert auc_mp > 0.9, out
+
+
 def test_mp_kmeans_two_hosts(tmp_path):
     """Each process reads its shard (rank/world), stats allreduce across
     processes — the reference's multi-node-without-a-cluster test."""
@@ -312,7 +389,7 @@ def test_mp_restarts_resume_after_crash(tmp_path):
         "worker never printed its final Progress line:\n"
         f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
     # the retry resumed at pass 2: ranks trained only passes 2-3
-    num_ex = int(r.stdout.split("num_ex=")[1].split()[0])
+    num_ex = parse_num_ex(r.stdout)[0]
     assert num_ex == 2 * 200, r.stdout
 
 
@@ -394,6 +471,62 @@ def test_mp_straggler_reexecution_crec(tmp_path):
     assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
     num_ex = int(rows[0].split("num_ex=")[1].split()[0])
     assert num_ex == total, out
+
+
+def test_mp_straggler_crash_during_reissue(tmp_path):
+    """Straggler x failure interaction (VERDICT r4 Missing #4): the host
+    that CLAIMS a re-issued straggler part kills itself at the moment of
+    the takeover claim. The launcher's --restarts relaunches the whole
+    world, the rebuilt pool re-runs the pass (no checkpoint configured:
+    recovery = full-pass re-execution), the straggler re-issue fires
+    again, and the job completes with exact global row accounting.
+    Reference: failure handler and straggler killer coexisting on live
+    pool state, workload_pool.h:111,125-140,169-190."""
+    rng = np.random.default_rng(31)
+    from wormhole_tpu.data.crec import CRecWriter
+    nnz, br = 8, 512
+    sizes = {"aa_big": 24 * br, "bb_small": 3 * br}
+    for name, n in sizes.items():
+        keys = rng.integers(1, 1 << 31, size=(n, nnz), dtype=np.uint32)
+        labels = (rng.random(n) < 0.5).astype(np.uint8)
+        with CRecWriter(str(tmp_path / f"{name}.crec"), nnz=nnz,
+                        block_rows=br) as w:
+            w.append(keys, labels)
+    total = sum(sizes.values())
+    marker = tmp_path / "crashed_once"
+    r = run_mp(2, f"""
+        import os
+        from wormhole_tpu.sched.workload_pool import ReplicatedRounds
+        _claimed = ReplicatedRounds.claimed
+        def claimed(self, r, wl):
+            skip = _claimed(self, r, wl)
+            # first straggler takeover: the NEW holder dies mid-claim
+            if (r == self.rank and skip > 0
+                    and not os.path.exists({str(marker)!r})):
+                open({str(marker)!r}, "w").close()
+                os._exit(17)
+            return skip
+        ReplicatedRounds.claimed = claimed
+        from wormhole_tpu.learners.async_sgd import AsyncSGD
+        from wormhole_tpu.utils.config import load_config
+        cfg = load_config(None, [
+            "train_data={tmp_path}/*.crec", "data_format=crec",
+            "num_buckets=65536", "lr_eta=0.1", "max_data_pass=1",
+            "disp_itv=1e12"])
+        app = AsyncSGD(cfg)
+        prog = app.run()
+        print(f"OK rank {{app.rt.rank}} num_ex={{prog.num_ex}}")
+    """, timeout=600, launcher_args=("--restarts", "2"), raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert marker.exists(), "crash never fired: re-issue claim not seen"
+    assert "straggler: re-queue" in r.stderr, r.stderr
+    assert "restart 1/2" in r.stderr, r.stderr
+    out = r.stdout
+    assert out.count("OK rank") == 2
+    rows = [ln for ln in out.splitlines() if "num_ex=" in ln]
+    assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
+    # the post-restart pass processed every row of every file exactly once
+    assert parse_num_ex(out)[0] == total, out
 
 
 def test_mp_straggler_reexecution_sparse(tmp_path):

@@ -165,6 +165,72 @@ def test_mesh_tile_step_matches_oracle(algo):
     assert err < 2e-2, err
 
 
+def test_mesh_tile_step_large_nb_cap_floor():
+    """Model-axis sharding in the HIGH-nb pad-floor regime (VERDICT r4
+    Missing #3): 128 tiles (nb=2^21) with ~64 pairs per (subblock, tile)
+    — cap floors at 128, so the pairs array is ~50% padding — sharded
+    model:4 across a data:2,model:4 CPU mesh. The mesh step must still
+    match the exact scatter oracle: pad words contribute nothing, tile
+    ranges partition cleanly at any tiles/shard, and gradients sum
+    across data shards."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.data.crec import CRec2Info
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.loss import logit_dual
+    from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+
+    rng = np.random.default_rng(9)
+    nb = 128 * tilemm.TILE          # 2^21 buckets, 32 tiles per shard
+    spec = tilemm.make_spec(nb, subblocks=1, cap=128)
+    n_pairs = 8192                  # ~64 per tile: deep in the pad floor
+    info = CRec2Info(nnz=1, block_rows=spec.block_rows,
+                     total_rows=2 * spec.block_rows, nb=nb,
+                     subblocks=1, cap=spec.cap, ovf_cap=0)
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh("data:2,model:4", jax.devices()[:8])
+    handle = FTRLHandle(penalty=L1L2(0.1, 0.01), lr=LearnRate(0.5, 1.0))
+    store = ShardedStore(StoreConfig(num_buckets=nb, loss="logit"),
+                         handle, rt)
+
+    blocks = {"pw": [], "labels": []}
+    raw = []
+    for _ in range(2):
+        buckets, rows = make_pairs(rng, n_pairs, spec)
+        pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+        assert not len(ovb)
+        # the point of the regime: most slots are pad
+        pad_frac = 1.0 - n_pairs / (spec.tiles * spec.cap)
+        assert pad_frac > 0.4, pad_frac
+        labels = (rng.random(spec.block_rows) < 0.4).astype(np.uint8)
+        blocks["pw"].append(pw)
+        blocks["labels"].append(labels)
+        raw.append((buckets, rows, labels))
+    blocks = {k: np.stack(v) for k, v in blocks.items()}
+
+    slots0 = np.asarray(store.slots)
+    store.tile_train_step_mesh(blocks, info)
+    got = np.asarray(jax.device_get(store.slots))
+
+    w0 = np.asarray(handle.weights(jnp.asarray(slots0)))
+    g_tot = np.zeros(nb, np.float64)
+    for buckets, rows, labels in raw:
+        mg = tilemm.forward_margins_ref(buckets, rows, w0,
+                                        spec.block_rows)
+        mask = np.ones(spec.block_rows, np.float32)
+        dual = np.asarray(logit_dual(
+            jnp.asarray(mg), jnp.asarray(labels.astype(np.float32)),
+            jnp.asarray(mask)))
+        g_tot += tilemm.backward_grad_ref(buckets, rows, dual, nb)
+    want = np.asarray(handle.push(jnp.asarray(slots0),
+                                  jnp.asarray(g_tot.astype(np.float32)),
+                                  jnp.float32(1), jnp.float32(0)))
+    err = np.max(np.abs(got - want)) / (np.abs(want).max() + 1e-9)
+    assert err < 2e-2, err
+
+
 def test_spec_validation():
     with pytest.raises(ValueError):
         tilemm.TileSpec(nb=1000, subblocks=2, cap=128)

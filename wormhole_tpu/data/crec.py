@@ -143,6 +143,8 @@ class CRecWriter:
         return self
 
     def __exit__(self, *exc):
+        from wormhole_tpu.data.stream import abort_on_error
+        abort_on_error(self._f, exc)
         self.close()
 
 
@@ -379,6 +381,8 @@ class CRec2Writer:
         return self
 
     def __exit__(self, *exc):
+        from wormhole_tpu.data.stream import abort_on_error
+        abort_on_error(self._f, exc)
         self.close()
 
 

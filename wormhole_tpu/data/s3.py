@@ -272,10 +272,24 @@ class _S3ReadStream(RangedReadStream):
 class _S3WriteBuffer(UploadOnCloseBuffer):
     """PUT-on-close through the shared upload scaffolding (S3 objects
     are immutable; no streaming-write shortcut is worth its complexity
-    at model-file sizes)."""
+    at model-file sizes).
+
+    Scope: single-PUT writes, intended for model/checkpoint-sized
+    objects. The whole object is buffered in RAM and S3 caps a single
+    PUT at 5 GiB, so bulk dataset conversions should target local disk
+    and be uploaded with a multipart-capable tool; exceeding the cap
+    raises here rather than failing opaquely server-side."""
+
+    _PUT_CAP = 5 << 30   # S3's single-PUT object limit
 
     def __init__(self, fs: S3FileSystem, bucket: str, key: str) -> None:
         def upload(body: bytes) -> None:
+            if len(body) > self._PUT_CAP:
+                raise ValueError(
+                    f"s3://{bucket}/{key}: {len(body)} bytes exceeds the "
+                    "5 GiB single-PUT limit (this backend buffers whole "
+                    "objects; write large conversions to local disk and "
+                    "upload with a multipart-capable tool)")
             st, _, data = fs._request("PUT", bucket, key, body=body)
             fs._check(st, data, f"write s3://{bucket}/{key}")
 

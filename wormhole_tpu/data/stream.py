@@ -107,21 +107,64 @@ class UploadOnCloseBuffer(io.BytesIO):
     """Local seekable buffer whose contents upload once on close — the
     shared write-side scaffolding of the remote streams. Seekability
     means header-backpatching writers (crec/crec2, BinnedCache) work
-    unchanged. ``_done`` flips only AFTER a successful upload, so a
-    caller that catches a transient failure can call close() again and
-    actually retry instead of silently succeeding."""
+    unchanged. The upload happens exactly once: a failed upload raises to
+    the caller (never silently succeeds) and the buffer is freed either
+    way.
+
+    A with-block that exits on an exception ABORTS the upload (the
+    buffered bytes are a half-written object that would otherwise publish
+    as a truncated-but-complete-looking file); and a close() whose upload
+    raises still releases the BytesIO on a later implicit/GC close
+    instead of re-attempting the upload from a destructor at an
+    arbitrary time."""
 
     def __init__(self, upload) -> None:
         """``upload(body: bytes)`` raises on failure."""
         super().__init__()
         self._upload = upload
         self._done = False
+        self._aborted = False
+
+    def abort(self) -> None:
+        """Discard the buffered bytes: close() becomes a no-op upload."""
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+    def __del__(self):
+        # a GC-time close must NEVER publish: a writer that crashed
+        # before its explicit close() holds a partial object, and
+        # io.IOBase.__del__ would otherwise upload it from the
+        # destructor at an arbitrary later time
+        self._aborted = True
+        try:
+            super().__del__()
+        except AttributeError:
+            pass
 
     def close(self) -> None:
-        if not self._done:
-            self._upload(self.getvalue())   # raises -> retryable
-            self._done = True
-        super().close()
+        if not self._done and not self._aborted:
+            self._aborted = True   # one attempt: GC close never re-uploads
+            try:
+                self._upload(self.getvalue())
+                self._done = True
+            finally:
+                super().close()    # a failed upload still frees the buffer
+        else:
+            super().close()
+
+
+def abort_on_error(f, exc) -> None:
+    """Writer ``__exit__`` helper: when the with-block raised and the
+    underlying stream supports it, discard the buffered upload — a
+    backpatched header would otherwise publish a truncated-but-
+    complete-looking object (close() still runs to free the buffer;
+    the upload is a no-op after abort)."""
+    if exc and exc[0] is not None and hasattr(f, "abort"):
+        f.abort()
 
 
 class _LazyFileSystem(FileSystem):

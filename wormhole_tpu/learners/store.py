@@ -916,8 +916,13 @@ class ShardedStore(TableCheckpoint):
                             f"model {path} was trained with "
                             f"key_fold={saved} but this run folds keys "
                             f"with {expect_key_fold} (crec formats hash "
-                            "differently from the text formats); retrain "
-                            "or convert the data, a warm start would "
+                            "differently from the text formats, and "
+                            "text data itself folds mix32 on the "
+                            "single-process text_dense fast path but "
+                            "splitmix64 under run_multihost — set "
+                            "text_dense=false to continue a multi-"
+                            "process model single-process); retrain or "
+                            "convert the data, a warm start would "
                             "remap every feature")
                 continue
             k, v = ln.split()

@@ -32,6 +32,7 @@ node.
 
 from __future__ import annotations
 
+import os
 import struct
 from dataclasses import dataclass, field
 from functools import partial
@@ -310,14 +311,94 @@ class SparseBins:
         self.num_feat = len(feat_ids)
 
 
+def _entry_quantile_cuts(ef: np.ndarray, ev: np.ndarray, F: int,
+                         num_bins: int) -> np.ndarray:
+    """Per-feature quantile cuts over CSR entries via one lexsort: each
+    feature's segment is sorted, quantile cut positions read out of the
+    sorted values (xgboost's present-values sketch semantics)."""
+    order = np.lexsort((ev, ef))
+    ef_s, ev_s = ef[order], ev[order]
+    starts = np.searchsorted(ef_s, np.arange(F))
+    ends = np.searchsorted(ef_s, np.arange(F) + 1)
+    lens = ends - starts
+    qs = np.linspace(0.0, 1.0, num_bins + 1)[1:-1]
+    cuts = np.zeros((F, num_bins - 1), np.float32)
+    nonempty = lens > 0
+    pos = (starts[:, None]
+           + np.minimum((qs[None, :] * np.maximum(lens, 1)[:, None])
+                        .astype(np.int64),
+                        np.maximum(lens - 1, 0)[:, None]))
+    cuts[nonempty] = ev_s[pos[nonempty]]
+    return cuts
+
+
+def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
+                          num_bins: int, runtime: MeshRuntime,
+                          sample_cap: int = 1 << 18
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Globally-agreed (feat_ids, cuts) for dsplit=row sparse training.
+
+    Every host must histogram into the SAME (feature, bin) space for the
+    per-level allreduce to be meaningful (the reference's distributed
+    xgboost agrees on sketch cuts the same way, via rabit allgather —
+    /root/reference/learn/xgboost/README.md:35-44). Two host collectives:
+
+    1. active-feature union: padded allgather of each host's unique ids;
+    2. cuts: each host contributes a deterministic bounded sample of its
+       (feature, value) entries; percentiles are taken over the merged
+       pool — exact when total entries fit the cap, an ordinary
+       merged-sketch approximation beyond it (same game as the dense
+       path's ``_global_cuts``)."""
+    from jax.experimental import multihost_utils
+    from wormhole_tpu.parallel.collectives import allreduce_tree
+    ids_local = np.unique(ef_orig)
+    n_max = int(allreduce_tree(np.int64(len(ids_local)), runtime.mesh,
+                               "max"))
+    if n_max == 0:
+        raise FileNotFoundError("no entries on any host")
+    buf = np.full(n_max, -1, np.int64)
+    buf[:len(ids_local)] = ids_local
+    gathered = np.asarray(multihost_utils.process_allgather(buf)).ravel()
+    feat_ids = np.unique(gathered[gathered >= 0])
+    # deterministic evenly-strided entry sample (no rng: every run of the
+    # same shard contributes the same entries)
+    take = min(len(ev), sample_cap)
+    sel = (np.linspace(0, max(len(ev) - 1, 0), take).astype(np.int64)
+           if take else np.zeros(0, np.int64))
+    cap_max = int(allreduce_tree(np.int64(take), runtime.mesh, "max"))
+    ef_buf = np.full(cap_max, -1, np.int64)
+    ev_buf = np.zeros(cap_max, np.float32)
+    ef_buf[:take] = ef_orig[sel]
+    ev_buf[:take] = ev[sel]
+    ef_m = np.asarray(multihost_utils.process_allgather(ef_buf)).ravel()
+    ev_m = np.asarray(multihost_utils.process_allgather(ev_buf)).ravel()
+    keep = ef_m >= 0
+    ef_m = np.searchsorted(feat_ids, ef_m[keep])
+    cuts = _entry_quantile_cuts(ef_m, ev_m[keep], len(feat_ids), num_bins)
+    # long-tail guard: a feature every host's sample missed gets all-zero
+    # cuts (splittable only as present-vs-missing) — flag it so a quiet
+    # accuracy divergence from single-process runs is at least visible
+    uncovered = len(feat_ids) - len(np.unique(ef_m))
+    if uncovered:
+        log.warning(
+            "sparse sketch: %d of %d active features have no sampled "
+            "entries (sample_cap=%d/host); their cuts are degenerate — "
+            "raise sample_cap if long-tail splits matter", uncovered,
+            len(feat_ids), sample_cap)
+    return feat_ids, cuts
+
+
 def load_sparse_binned(uri: str, data_format: str = "libsvm",
                        num_bins: int = 256, part: int = 0, nparts: int = 1,
-                       ref: Optional[SparseBins] = None) -> SparseBins:
+                       ref: Optional[SparseBins] = None,
+                       runtime: Optional[MeshRuntime] = None) -> SparseBins:
     """Stream a sparse uri into entry arrays + quantile cuts without ever
     densifying. Cuts are per-feature percentiles of PRESENT values
     (xgboost's sketch semantics); pass the training ``ref`` to bin
     val/test data with the training sketch (entries of features unseen at
-    train time are dropped, xgboost-like)."""
+    train time are dropped, xgboost-like). With a multi-process
+    ``runtime``, feature ids and cuts are agreed globally so dsplit=row
+    shards histogram into one shared (feature, bin) space."""
     from wormhole_tpu.data.minibatch import MinibatchIter
     rows_l: List[np.ndarray] = []
     feats_l: List[np.ndarray] = []
@@ -332,18 +413,29 @@ def load_sparse_binned(uri: str, data_format: str = "libsvm",
         vals_l.append(vals.astype(np.float32))
         labels_l.append(blk.label.copy())
         base += blk.size
-    if base == 0:
+    if base == 0 and (runtime is None or runtime.world == 1):
         raise FileNotFoundError(f"no rows in {uri}")
-    er = np.concatenate(rows_l)
-    ef_orig = np.concatenate(feats_l)
-    ev = np.concatenate(vals_l)
-    labels = np.concatenate(labels_l)
+    # an empty dsplit=row shard (tiny file, part with no complete line)
+    # must still reach the sketch collectives below — raising here would
+    # wedge the other hosts inside process_allgather; it contributes
+    # zero entries and the sketch raises ON ALL HOSTS if the global
+    # total is zero
+    er = (np.concatenate(rows_l) if rows_l else np.zeros(0, np.int64))
+    ef_orig = (np.concatenate(feats_l) if feats_l
+               else np.zeros(0, np.int64))
+    ev = (np.concatenate(vals_l) if vals_l else np.zeros(0, np.float32))
+    labels = (np.concatenate(labels_l) if labels_l
+              else np.zeros(0, np.float32))
     if ref is not None:
         feat_ids, cuts = ref.feat_ids, ref.cuts
         ef = np.searchsorted(feat_ids, ef_orig)
         ef = np.clip(ef, 0, len(feat_ids) - 1)
         keep = feat_ids[ef] == ef_orig   # drop unseen-at-train features
         er, ef, ev = er[keep], ef[keep], ev[keep]
+    elif runtime is not None and runtime.world > 1:
+        feat_ids, cuts = _global_sparse_sketch(ef_orig, ev, num_bins,
+                                               runtime)
+        ef = np.searchsorted(feat_ids, ef_orig)  # all present: union
     else:
         # compact the active feature set (the Localizer move): hists and
         # cuts are indexed by the dense active id
@@ -355,22 +447,8 @@ def load_sparse_binned(uri: str, data_format: str = "libsvm",
         raise ValueError(
             f"{F} active features x {num_bins} bins exceeds the histogram "
             "budget; lower num_bins or prune/hash the feature space")
-    order = np.lexsort((ev, ef))
-    ef_s, ev_s = ef[order], ev[order]
-    starts = np.searchsorted(ef_s, np.arange(F))
-    ends = np.searchsorted(ef_s, np.arange(F) + 1)
-    lens = ends - starts
     if cuts is None:
-        # per-feature percentiles via one lexsort: each feature's segment
-        # is sorted, quantile cut positions read out of the sorted values
-        qs = np.linspace(0.0, 1.0, num_bins + 1)[1:-1]
-        cuts = np.zeros((F, num_bins - 1), np.float32)
-        nonempty = lens > 0
-        pos = (starts[:, None]
-               + np.minimum((qs[None, :] * np.maximum(lens, 1)[:, None])
-                            .astype(np.int64),
-                            np.maximum(lens - 1, 0)[:, None]))
-        cuts[nonempty] = ev_s[pos[nonempty]]
+        cuts = _entry_quantile_cuts(ef, ev, F, num_bins)
     # bin: #cuts strictly below the value (searchsorted-left semantics),
     # vectorized in chunks so the (chunk, B-1) compare stays cache-sized
     eb = np.empty(len(ev), np.int32)
@@ -631,10 +709,22 @@ class GBDT:
         from wormhole_tpu.data.minibatch import MinibatchIter
         from wormhole_tpu.parallel.collectives import allreduce_tree
         cfg = self.cfg
-        # per-(part,rank) default: dsplit=row processes each stream their
-        # own part — a shared path would interleave two caches
-        cache_path = cache_path or (
-            f"{uri.split(';')[0]}.part{part}of{nparts}.binned.cache")
+        # default cache: LOCAL scratch keyed by the source uri — the
+        # training data may live somewhere unwritable (read-only dir,
+        # s3:// without write perms), and a remote cache would round-trip
+        # through RAM per pass; an explicit cache_path is honored as
+        # given for callers who want cache reuse next to the data
+        own_cache = not cache_path
+        if not cache_path:
+            import hashlib
+            import tempfile as _tf
+            tag = hashlib.sha1(uri.encode()).hexdigest()[:12]
+            # uid+pid keep concurrent runs / users from clobbering or
+            # permission-colliding on one shared-tempdir name
+            cache_path = os.path.join(
+                _tf.gettempdir(),
+                f"wh_gbdt_{tag}_u{os.getuid()}_p{os.getpid()}"
+                f".part{part}of{nparts}.binned.cache")
         # pass 1: discover F, collect labels + a bounded sparse sample
         F = num_features
         labels_parts: List[np.ndarray] = []
@@ -668,7 +758,16 @@ class GBDT:
                                  chunk_rows):
             cache.append(apply_bins(_densify_block(blk, F), self.cuts))
         cache.close()
-        return self._boost_external(cache, labels_np, start_round)
+        try:
+            return self._boost_external(cache, labels_np, start_round)
+        finally:
+            if own_cache:
+                # default scratch caches are per-run (no reuse logic
+                # exists); don't leak a dataset-sized file in tempdir
+                try:
+                    os.remove(cache_path)
+                except OSError:
+                    pass
 
     def _boost_external(self, cache: "BinnedCache",
                         labels_np: np.ndarray,
@@ -1195,17 +1294,14 @@ def main(argv=None) -> int:
     part, nparts = rt.local_part()
     model = GBDT(cli, rt)
     if cli.sparse:
-        if rt.world > 1:
-            raise NotImplementedError(
-                "sparse=true multi-process needs globally agreed cuts; "
-                "run single-process or use the dense path")
         data = load_sparse_binned(cli.data, cli.data_format, cli.num_bins,
-                                  part, nparts)
+                                  part, nparts, runtime=rt)
         model.fit_sparse(data)
         log.info("train metrics: %s", model.evaluate_sparse(data))
         if cli.val_data:
             dv = load_sparse_binned(cli.val_data, cli.data_format,
-                                    cli.num_bins, part, nparts, ref=data)
+                                    cli.num_bins, part, nparts, ref=data,
+                                    runtime=rt)
             log.info("val metrics: %s", model.evaluate_sparse(dv))
     elif cli.external:
         model.fit_external(cli.data, cli.data_format,

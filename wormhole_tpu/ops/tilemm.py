@@ -396,10 +396,30 @@ def _build_bwd(spec: TileSpec):
 # scatters per-(row,channel) values into per-(bucket,channel) sums.
 #
 # Channels ride contiguous 128-lane slices (channel-major: lane block j
-# holds channel j), so the expensive digit one-hots, the pair-word
-# relayout, and the transposed histogram lhs are built ONCE per
-# (group, tile) and reused by every channel — per-channel cost is pure
-# MXU (gather + pick + hist), the irreducible lanes-linear part.
+# holds channel j), and everything that CAN contract all channels at once
+# does (round-5 batching; round 4 ran a full per-channel chain and
+# measured ch x the scalar step):
+#
+#   * gather:   ONE (N,128) @ (128, ch*128) matmul — the one-hot lhs is
+#     shared, so ch gathers are one long-lane matmul (same flops, one
+#     issue);
+#   * histogram: the transposed one-hot lhs is channel-independent, so
+#     each subblock's ch histograms are ONE (RH, C) @ (C, ch*RL) matmul;
+#   * masks: applied once across all ch*128 lanes (iota % 128 compare) —
+#     same element count, ch x fewer VPU issues.
+#
+# Only the lane pick (the cross-lane reduce) is irreducibly per-channel:
+# a single matmul over all channels would need a block-diagonal rhs and
+# ch x the flops. Per-channel cost is therefore ONE (N,128)@(128,RL)
+# matmul plus 1/ch of every shared op.
+
+
+def _wide_cond(rep: jax.Array, shift: int, mask: int, n: int,
+               lanes: int, width: int) -> jax.Array:
+    """(n, lanes) digit compare replicated across lane blocks of
+    ``width`` (iota % width) — one compare covering every channel."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, lanes), 1)
+    return ((rep >> shift) & mask) == (iota % width)
 
 
 def _mask_where(cond: jax.Array, x: jax.Array) -> jax.Array:
@@ -417,49 +437,47 @@ def _fwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, w_ref, mg_ref):
 
     S, GS, C, N = spec.subblocks, spec.group, spec.cap, spec.n
     ones_pick = jnp.ones((B_LO, RL), jnp.bfloat16)
-    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (N, 128), 1)
-    iota_rlo = jax.lax.broadcasted_iota(jnp.int32, (N, RL), 1)
     for g in range(S // GS):
-        mgs = [[mg_ref[g * GS + j, jc] for jc in range(ch)]
-               for j in range(GS)]
+        mgs = [mg_ref[g * GS + j] for j in range(GS)]      # (RH, ch*RL)
         for tb in range(spec.tiles_step):
             pc = pw_ref[tb, g].astype(jnp.int32)           # (N,)
             rep = pc[:, None]                              # ONE relayout
             ohhi = _oh_rep(rep, HI_SH, HI_M, N, 128)       # pad -> 0 row
-            cond_lo = ((rep >> LO_SH) & LO_M) == iota_lo
-            cond_rlo = ((rep >> RLO_SH) & RLO_M) == iota_rlo
+            cond_lo = _wide_cond(rep, LO_SH, LO_M, N, ch * 128, 128)
+            cond_rlo = _wide_cond(rep, RLO_SH, RLO_M, N, ch * RL, RL)
             rhiTs = [_ohT_vec(pc[j * C:(j + 1) * C], RHI_SH, RHI_M,
                               RH, C) for j in range(GS)]
-            for jc in range(ch):
-                wt = w_ref[tb, :, jc * B_LO:(jc + 1) * B_LO]
-                m = jnp.dot(ohhi, wt,
+            # batched gather: every channel in one long-lane matmul
+            m_all = jnp.dot(ohhi, w_ref[tb],
                             preferred_element_type=jnp.float32)
-                wp = jnp.dot(_mask_where(cond_lo, m), ones_pick,
-                             preferred_element_type=jnp.float32)
-                rhs = _mask_where(cond_rlo, wp)            # (N, RL)
-                for j in range(GS):
-                    mgs[j][jc] += jnp.dot(
-                        rhiTs[j], rhs[j * C:(j + 1) * C],
-                        preferred_element_type=jnp.float32)
+            masked = _mask_where(cond_lo, m_all)           # (N, ch*128)
+            # lane pick per channel (the irreducible part), re-joined on
+            # lanes so the spread mask and histogram run channel-wide
+            wp_all = jnp.concatenate(
+                [jnp.dot(masked[:, jc * 128:(jc + 1) * 128], ones_pick,
+                         preferred_element_type=jnp.float32)
+                 for jc in range(ch)], axis=1)             # (N, ch*RL)
+            rhs = _mask_where(cond_rlo, wp_all)
+            for j in range(GS):
+                mgs[j] += jnp.dot(rhiTs[j], rhs[j * C:(j + 1) * C],
+                                  preferred_element_type=jnp.float32)
         for j in range(GS):
-            for jc in range(ch):
-                mg_ref[g * GS + j, jc] = mgs[j][jc]
+            mg_ref[g * GS + j] = mgs[j]
 
 
 def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
     """dual_ref (S//bp, bp*RH, ch*RL): per-channel row grids on
     contiguous lane blocks; same paired-subblock value chain as the
-    scalar bwd kernel, digit work hoisted out of the channel loop."""
+    scalar bwd kernel, digit work hoisted out of the channel loop and
+    the dual gather + grad histogram contracted channel-wide."""
     S, GS, C = spec.subblocks, spec.group, spec.cap
     bp = _bp(spec)
     NC = bp * C
     ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
     offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
     iota_ghi = jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
-    iota_rlo = jax.lax.broadcasted_iota(jnp.int32, (NC, RL), 1)
-    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (NC, 128), 1)
     for tb in range(spec.tiles_step):
-        accs = [jnp.zeros((A_HI, B_LO), jnp.float32) for _ in range(ch)]
+        acc = jnp.zeros((A_HI, ch * B_LO), jnp.float32)
         for g in range(S // GS):
             for h in range(GS // bp):
                 sp = (g * GS) // bp + h
@@ -467,32 +485,36 @@ def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
                 rep = pc[:, None]                          # one relayout
                 ohghi = ((((rep >> RHI_SH) & RHI_M) + offs)
                          == iota_ghi).astype(jnp.bfloat16)
-                cond_rlo = ((rep >> RLO_SH) & RLO_M) == iota_rlo
-                cond_lo = ((rep >> LO_SH) & LO_M) == iota_lo
+                cond_rlo = _wide_cond(rep, RLO_SH, RLO_M, NC,
+                                      ch * RL, RL)
+                cond_lo = _wide_cond(rep, LO_SH, LO_M, NC, ch * 128, 128)
                 ohhiTs = [_ohT_vec(pc[j * C:(j + 1) * C], HI_SH, HI_M,
                                    A_HI, C) for j in range(bp)]
-                for jc in range(ch):
-                    md = jnp.dot(ohghi,
-                                 dual_ref[sp, :, jc * RL:(jc + 1) * RL],
+                # batched dual gather: all channels in one matmul
+                md_all = jnp.dot(ohghi, dual_ref[sp],
                                  preferred_element_type=jnp.float32)
-                    dp = jnp.dot(_mask_where(cond_rlo, md), ones_bcast,
-                                 preferred_element_type=jnp.float32)
-                    rhs = _mask_where(cond_lo, dp)         # (NC, 128)
-                    for j in range(bp):
-                        accs[jc] += jnp.dot(
-                            ohhiTs[j], rhs[j * C:(j + 1) * C],
-                            preferred_element_type=jnp.float32)
-        for jc in range(ch):
-            g_ref[tb, jc] = accs[jc]
+                masked = _mask_where(cond_rlo, md_all)     # (NC, ch*RL)
+                dp_all = jnp.concatenate(
+                    [jnp.dot(masked[:, jc * RL:(jc + 1) * RL], ones_bcast,
+                             preferred_element_type=jnp.float32)
+                     for jc in range(ch)], axis=1)         # (NC, ch*128)
+                rhs = _mask_where(cond_lo, dp_all)
+                for j in range(bp):
+                    acc += jnp.dot(ohhiTs[j], rhs[j * C:(j + 1) * C],
+                                   preferred_element_type=jnp.float32)
+        g_ref[tb] = acc
 
 
 def _multi_spec(spec: TileSpec, ch: int) -> TileSpec:
-    """Shrink tiles_step so the unrolled kernel body (~ tiles_step * ch
-    matmul chains) stays near the ch=1 compile budget — tiles_step=16 at
-    ch=10 measured a >10 min remote compile."""
+    """Shrink tiles_step so the unrolled kernel body stays near the ch=1
+    compile budget. The round-5 batched kernels carry ~(2 + GS + ch)
+    matmuls per (group, tile) vs the old ~(2 + GS) * ch, so the budget is
+    on tiles_step * (ch + 6) rather than tiles_step * ch * 6 — tb=8 at
+    ch=10 compiles in the tb=16 scalar envelope (measured round 5);
+    tiles_step=16 at ch=10 with the OLD kernels measured >10 min."""
     import dataclasses
     tb = max((t for t in (16, 8, 4, 2)
-              if spec.tiles % t == 0 and t * ch <= 32), default=1)
+              if spec.tiles % t == 0 and t * (ch + 6) <= 128), default=1)
     return dataclasses.replace(spec, tiles_step=tb)
 
 
@@ -514,14 +536,15 @@ def _build_fwd_multi(spec: TileSpec, ch: int):
                 pl.BlockSpec((TB, SG, N), lambda t: (t, 0, 0)),
                 pl.BlockSpec((TB, A_HI, ch * B_LO), lambda t: (t, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((S, ch, RH, RL), lambda t: (0, 0, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct((S, ch, RH, RL), jnp.float32),
+            out_specs=pl.BlockSpec((S, RH, ch * RL), lambda t: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((S, RH, ch * RL), jnp.float32),
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
         )(pw, wt)
-        # (S, ch, RH, RL) -> (rows, ch)
-        return mg.transpose(0, 2, 3, 1).reshape(spec.block_rows, ch)
+        # (S, RH, ch*RL) channel-major lanes -> (rows, ch)
+        return (mg.reshape(S, RH, ch, RL).transpose(0, 1, 3, 2)
+                .reshape(spec.block_rows, ch))
 
     return fwd
 
@@ -547,16 +570,17 @@ def _build_bwd_multi(spec: TileSpec, ch: int):
                 pl.BlockSpec((S // bp, bp * RH, ch * RL),
                              lambda t: (0, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((TB, ch, A_HI, B_LO),
-                                   lambda t: (t, 0, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct((T, ch, A_HI, B_LO),
+            out_specs=pl.BlockSpec((TB, A_HI, ch * B_LO),
+                                   lambda t: (t, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((T, A_HI, ch * B_LO),
                                            jnp.float32),
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
         )(pw, dg)
-        # (T, ch, A_HI, B_LO) -> (nb, ch)
-        return g.transpose(0, 2, 3, 1).reshape(spec.nb, ch)
+        # (T, A_HI, ch*B_LO) channel-major lanes -> (nb, ch)
+        return (g.reshape(T, A_HI, ch, B_LO).transpose(0, 1, 3, 2)
+                .reshape(spec.nb, ch))
 
     return bwd
 

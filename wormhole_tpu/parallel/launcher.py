@@ -66,16 +66,46 @@ def launch_sim(n: int, cmd: List[str]) -> int:
     return subprocess.call(cmd, env=env)
 
 
+def _pump_lines(stream, sink, lock) -> None:
+    """Relay one child's output to ``sink`` a full line at a time.
+
+    Children block-buffer when stdout is a pipe, so two ranks writing the
+    shared pipe directly can flush MID-line (observed: ``num_ex=400OK`` —
+    two ranks' lines spliced). Reading per-child pipes and writing whole
+    lines under one lock makes the merged stream line-atomic, so tests
+    (and any log consumer) can parse it with line-anchored patterns."""
+    for line in iter(stream.readline, b""):
+        with lock:
+            sink.write(line)
+            sink.flush()
+    stream.close()
+
+
 def launch_mp(n: int, cmd: List[str]) -> int:
+    import threading
     port = _free_port()
     procs = []
+    pumps = []
+    out_lock = threading.Lock()
     for i in range(n):
         env = _base_env()
         env["JAX_PLATFORMS"] = "cpu"
+        # children write a pipe (block-buffered by default): unbuffer so
+        # a killed/crashed rank doesn't lose its last lines and live runs
+        # stream instead of bursting every 8KB
+        env["PYTHONUNBUFFERED"] = "1"
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["NUM_PROCESSES"] = str(n)
         env["PROCESS_ID"] = str(i)
-        procs.append(subprocess.Popen(cmd, env=env))
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+        procs.append(p)
+        for stream, sink in ((p.stdout, sys.stdout.buffer),
+                             (p.stderr, sys.stderr.buffer)):
+            t = threading.Thread(target=_pump_lines,
+                                 args=(stream, sink, out_lock), daemon=True)
+            t.start()
+            pumps.append(t)
     import time as _time
     rc = 0
     try:
@@ -91,7 +121,9 @@ def launch_mp(n: int, cmd: List[str]) -> int:
                 if code is None:
                     continue
                 live.remove(p)
-                rc = code or rc
+                rc = rc or code   # first failure wins (terminated
+                                  # bystanders exit -15 and must not
+                                  # mask the originating code)
                 if code != 0:
                     for q in live:
                         q.terminate()
@@ -105,6 +137,8 @@ def launch_mp(n: int, cmd: List[str]) -> int:
                 p.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for t in pumps:
+            t.join(timeout=10)
     return rc
 
 

@@ -62,7 +62,12 @@ class Config:
     straggler_factor: float = 3.0
     # dense text fast path: binary-feature text formats (criteo/adfea)
     # stream as natively-assembled in-memory crec blocks through the
-    # dense-apply device step instead of localize+pad in Python
+    # dense-apply device step instead of localize+pad in Python.
+    # NOTE: this path folds keys with mix32 (the crec fold) while the
+    # multi-process sparse path folds splitmix64, so a model saved from
+    # a single-process text run cannot warm-start a multi-process run of
+    # the same data (load_model hard-errors on the recorded key_fold);
+    # set text_dense=false when a model must move between launch modes
     text_dense: bool = True
     text_block_rows: int = 16384
 

@@ -405,6 +405,45 @@ def bench_device_wide_deep(path: str) -> float:
     return info.block_rows / per_step
 
 
+def bench_device_dense_apply() -> float:
+    """The crec v1 / text_dense fused step on a device-resident raw
+    block buffer (on-device key fold + full-width scatter apply) — the
+    slow-but-exact cousin of the tile step, measured so the v1 path has
+    a number of its own (VERDICT r4 Weak #7)."""
+    import jax
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    rng = np.random.default_rng(3)
+    R, N = 16384, CRITEO_NNZ       # text_block_rows default x criteo nnz
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    store = ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS,
+                                     loss="logit"), handle)
+    blocks = []
+    for _ in range(2):
+        keys = rng.integers(0, 1 << 32, size=R * N, dtype=np.uint32)
+        keys[keys == 0xFFFFFFFF] = 0
+        labels = (rng.random(R) < 0.25).astype(np.uint8)
+        packed = np.concatenate([keys.view(np.uint8),
+                                 labels.view(np.uint8)])
+        blocks.append(jax.device_put(packed))
+
+    def run(steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            store.dense_train_step(blocks[i % 2], R, N)
+        jax.block_until_ready(store.slots)
+        float(np.asarray(store.slots[0, 0]))
+        return time.perf_counter() - t0
+
+    run(3)
+    n = 10
+    t1 = _median_window(lambda: run(n), repeats=3)
+    t2 = _median_window(lambda: run(2 * n), repeats=3)
+    per_step = max((t2 - t1) / n, 1e-9)
+    return R / per_step
+
+
 def bench_kmeans() -> dict:
     """k-means iteration time at the MNIST-784 shape (BASELINE.json's
     learn/kmeans config: dense 60000 x 784, k=10). One BSP iteration =
@@ -604,17 +643,29 @@ def main() -> None:
     write_crec2(crec2_path, E2E_ROWS, rng)
     write_criteo_text(text_path, TEXT_ROWS, rng)
 
-    e2e = bench_e2e_crec2(crec2_path)
-    tile = bench_device_tile(crec2_path)
-    stream = bench_e2e_stream(crec2_path)
-    text = bench_e2e_text(text_path)
-    fm = bench_device_fm(crec2_path)
-    wd = bench_device_wide_deep(crec2_path)
-    sparse = bench_device_sparse()
-    scale = bench_scale_curve(workdir, rng)
-    kmeans = bench_kmeans()
-    lbfgs = bench_lbfgs()
-    gbdt = bench_gbdt()
+    import sys
+
+    def _phase(name, fn):
+        print(f"[bench] {name}...", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        out = fn()
+        print(f"[bench] {name} done in {time.perf_counter()-t0:.0f}s",
+              file=sys.stderr, flush=True)
+        return out
+
+    e2e = _phase("e2e_crec2", lambda: bench_e2e_crec2(crec2_path))
+    tile = _phase("device_tile", lambda: bench_device_tile(crec2_path))
+    stream = _phase("e2e_stream", lambda: bench_e2e_stream(crec2_path))
+    text = _phase("e2e_text", lambda: bench_e2e_text(text_path))
+    fm = _phase("device_fm", lambda: bench_device_fm(crec2_path))
+    wd = _phase("device_wide_deep",
+                lambda: bench_device_wide_deep(crec2_path))
+    sparse = _phase("device_sparse", bench_device_sparse)
+    dense = _phase("device_dense_apply", bench_device_dense_apply)
+    scale = _phase("scale_curve", lambda: bench_scale_curve(workdir, rng))
+    kmeans = _phase("kmeans", bench_kmeans)
+    lbfgs = _phase("lbfgs", bench_lbfgs)
+    gbdt = _phase("gbdt", bench_gbdt)
 
     for p in (crec2_path, text_path):
         try:
@@ -646,6 +697,7 @@ def main() -> None:
             "hbm_gbps": round(tile["hbm_gbps"], 1),
             "hbm_peak_gbps": peak_hbm,
             "device_step_sparse_examples_per_sec": round(sparse, 1),
+            "device_step_dense_apply_examples_per_sec": round(dense, 1),
             "device_step_fm_examples_per_sec": round(fm, 1),
             "device_step_wide_deep_examples_per_sec": round(wd, 1),
             "scale_curve_tile_step": scale,

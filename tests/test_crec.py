@@ -269,3 +269,24 @@ def test_zero_dual_nudge_keeps_saturated_rows_touching():
     assert out[1] == np.float32(1e-30)       # neg row pushes positive
     assert out[2] == 0.0                     # masked row stays untouched
     assert out[3] == np.asarray(dual)[3]     # live duals unchanged
+
+
+def test_writer_exception_never_publishes_partial(tmp_path, rng):
+    """A with-block that raises mid-write must NOT leave a valid-looking
+    truncated file: local outputs truncate to zero bytes (a later reader
+    fails the header parse loudly) — the same invariant the remote
+    writers enforce by aborting the buffered upload (stream.py
+    discard_output)."""
+    path = tmp_path / "partial.crec"
+    keys = rng.integers(1, 1 << 31, size=(64, 4), dtype=np.uint32)
+    with pytest.raises(RuntimeError):
+        with CRecWriter(str(path), nnz=4, block_rows=16) as w:
+            w.append(keys, np.zeros(64, np.uint8))
+            raise RuntimeError("mid-conversion crash")
+    assert path.stat().st_size == 0
+    with pytest.raises(Exception):
+        read_header(str(path))
+    # and the normal path still publishes fine afterwards
+    with CRecWriter(str(path), nnz=4, block_rows=16) as w:
+        w.append(keys, np.zeros(64, np.uint8))
+    assert read_header(str(path)).total_rows == 64

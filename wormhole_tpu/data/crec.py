@@ -143,8 +143,15 @@ class CRecWriter:
         return self
 
     def __exit__(self, *exc):
-        from wormhole_tpu.data.stream import abort_on_error
-        abort_on_error(self._f, exc)
+        if exc and exc[0] is not None and self._f is not None:
+            # exception mid-write: never publish — remote buffers abort
+            # the upload, local files truncate to zero (a header
+            # backpatch here would make the partial file look complete)
+            from wormhole_tpu.data.stream import discard_output
+            discard_output(self._f)
+            self._f.close()
+            self._f = None
+            return
         self.close()
 
 
@@ -381,8 +388,15 @@ class CRec2Writer:
         return self
 
     def __exit__(self, *exc):
-        from wormhole_tpu.data.stream import abort_on_error
-        abort_on_error(self._f, exc)
+        if exc and exc[0] is not None and self._f is not None:
+            # exception mid-write: never publish — remote buffers abort
+            # the upload, local files truncate to zero (a header
+            # backpatch here would make the partial file look complete)
+            from wormhole_tpu.data.stream import discard_output
+            discard_output(self._f)
+            self._f.close()
+            self._f = None
+            return
         self.close()
 
 

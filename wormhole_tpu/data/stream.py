@@ -157,14 +157,20 @@ class UploadOnCloseBuffer(io.BytesIO):
             super().close()
 
 
-def abort_on_error(f, exc) -> None:
-    """Writer ``__exit__`` helper: when the with-block raised and the
-    underlying stream supports it, discard the buffered upload — a
-    backpatched header would otherwise publish a truncated-but-
-    complete-looking object (close() still runs to free the buffer;
-    the upload is a no-op after abort)."""
-    if exc and exc[0] is not None and hasattr(f, "abort"):
+def discard_output(f) -> None:
+    """Writer error-path helper: invalidate a partially-written output
+    so it can never read as a truncated-but-complete-looking file.
+    Remote upload buffers abort (nothing publishes); local files
+    truncate to zero bytes (a later reader fails the header parse
+    loudly instead of consuming a silently shorter dataset)."""
+    if hasattr(f, "abort"):
         f.abort()
+        return
+    try:
+        f.seek(0)
+        f.truncate(0)
+    except (OSError, ValueError):
+        pass
 
 
 class _LazyFileSystem(FileSystem):

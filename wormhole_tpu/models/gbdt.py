@@ -753,17 +753,19 @@ class GBDT:
                 self.cuts = self._global_cuts(sample_x)
         del sample_blocks
         # pass 2: bin chunks into the on-disk cache
-        cache = BinnedCache.create(cache_path, F, chunk_rows)
-        for blk in MinibatchIter(uri, part, nparts, data_format,
-                                 chunk_rows):
-            cache.append(apply_bins(_densify_block(blk, F), self.cuts))
-        cache.close()
         try:
+            cache = BinnedCache.create(cache_path, F, chunk_rows)
+            for blk in MinibatchIter(uri, part, nparts, data_format,
+                                     chunk_rows):
+                cache.append(apply_bins(_densify_block(blk, F),
+                                        self.cuts))
+            cache.close()
             return self._boost_external(cache, labels_np, start_round)
         finally:
             if own_cache:
                 # default scratch caches are per-run (no reuse logic
-                # exists); don't leak a dataset-sized file in tempdir
+                # exists); don't leak a dataset-sized file in tempdir —
+                # including a partial one from a failed build pass
                 try:
                     os.remove(cache_path)
                 except OSError:

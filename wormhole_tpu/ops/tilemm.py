@@ -219,20 +219,25 @@ def encode_block(buckets: np.ndarray, rows: np.ndarray,
 def _oh_rep(rep: jax.Array, shift: int, mask: int, n: int,
             width: int) -> jax.Array:
     """(n, width) bf16 one-hot of a digit of the sublane-replicated packed
-    word (32-bit compare + i1->bf16 convert; v5e has no 16-bit compares)."""
-    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1)
-    return (((rep >> shift) & mask) == iota).astype(jnp.bfloat16)
+    word. The field is compared IN PLACE — ``rep & (mask<<shift)`` against
+    a pre-shifted iota constant — which drops the per-site shift pass the
+    old ``(rep>>shift)&mask`` form paid on the (n,1) word column (the
+    round-5 floor model: the kernels are bound by exactly these
+    vreg-level VPU passes, docs/perf.md)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1) << shift
+    return ((rep & (mask << shift)) == iota).astype(jnp.bfloat16)
 
 
 def _mask_sel(rep: jax.Array, shift: int, mask: int,
               x: jax.Array) -> jax.Array:
-    """x masked by a digit one-hot, as ONE select: where(digit==lane, x, 0)
-    then a single f32->bf16 convert — one VPU pass fewer per site than
-    building the bf16 one-hot and multiplying (cmp/sel/astype/mul)."""
+    """x masked by a digit one-hot, as one in-place compare + a bf16
+    select: the f32->bf16 convert runs BEFORE the select so the select
+    touches half the vregs, and the field compares in place (no shift
+    pass) — two fewer VPU passes per site than cmp/sel-f32/convert."""
     n, width = x.shape
-    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1)
-    cond = ((rep >> shift) & mask) == iota
-    return jnp.where(cond, x, jnp.float32(0)).astype(jnp.bfloat16)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1) << shift
+    cond = (rep & (mask << shift)) == iota
+    return jnp.where(cond, x.astype(jnp.bfloat16), jnp.bfloat16(0))
 
 
 def _ohT_vec(vec: jax.Array, shift: int, mask: int, width: int,
@@ -307,8 +312,12 @@ def _bwd_kernel(spec: TileSpec, pw_ref, dual_ref, g_ref):
     NC = bp * C
     ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
     # chain-local subblock offset of each pair (static)
+    # joint subblock-parity digit compared IN PLACE: the chain-local
+    # offset folds into the shifted iota constant (rows where
+    # iota - offs < 0 go negative and match no masked field)
     offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
-    iota_ghi = jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+    iota_ghi_sh = ((jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+                    - offs) << RHI_SH)
     for tb in range(spec.tiles_step):
         acc = jnp.zeros((A_HI, B_LO), jnp.float32)
         for g in range(S // GS):
@@ -316,8 +325,8 @@ def _bwd_kernel(spec: TileSpec, pw_ref, dual_ref, g_ref):
                 sp = (g * GS) // bp + h
                 pc = pw_ref[tb, g, h * NC:(h + 1) * NC].astype(jnp.int32)
                 rep = pc[:, None]                          # one relayout
-                ohghi = ((((rep >> RHI_SH) & RHI_M) + offs)
-                         == iota_ghi).astype(jnp.bfloat16)
+                ohghi = ((rep & (RHI_M << RHI_SH))
+                         == iota_ghi_sh).astype(jnp.bfloat16)
                 md = jnp.dot(ohghi, dual_ref[sp],
                              preferred_element_type=jnp.float32)
                 dp = jnp.dot(_mask_sel(rep, RLO_SH, RLO_M, md), ones_bcast,
@@ -419,7 +428,7 @@ def _wide_cond(rep: jax.Array, shift: int, mask: int, n: int,
     """(n, lanes) digit compare replicated across lane blocks of
     ``width`` (iota % width) — one compare covering every channel."""
     iota = jax.lax.broadcasted_iota(jnp.int32, (n, lanes), 1)
-    return ((rep >> shift) & mask) == (iota % width)
+    return (rep & (mask << shift)) == ((iota % width) << shift)
 
 
 def _mask_where(cond: jax.Array, x: jax.Array) -> jax.Array:
@@ -474,8 +483,12 @@ def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
     bp = _bp(spec)
     NC = bp * C
     ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
+    # joint subblock-parity digit compared IN PLACE: the chain-local
+    # offset folds into the shifted iota constant (rows where
+    # iota - offs < 0 go negative and match no masked field)
     offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
-    iota_ghi = jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+    iota_ghi_sh = ((jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+                    - offs) << RHI_SH)
     for tb in range(spec.tiles_step):
         acc = jnp.zeros((A_HI, ch * B_LO), jnp.float32)
         for g in range(S // GS):
@@ -483,8 +496,8 @@ def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
                 sp = (g * GS) // bp + h
                 pc = pw_ref[tb, g, h * NC:(h + 1) * NC].astype(jnp.int32)
                 rep = pc[:, None]                          # one relayout
-                ohghi = ((((rep >> RHI_SH) & RHI_M) + offs)
-                         == iota_ghi).astype(jnp.bfloat16)
+                ohghi = ((rep & (RHI_M << RHI_SH))
+                         == iota_ghi_sh).astype(jnp.bfloat16)
                 cond_rlo = _wide_cond(rep, RLO_SH, RLO_M, NC,
                                       ch * RL, RL)
                 cond_lo = _wide_cond(rep, LO_SH, LO_M, NC, ch * 128, 128)

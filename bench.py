@@ -444,6 +444,59 @@ def bench_device_dense_apply() -> float:
     return R / per_step
 
 
+def bench_channel_ratios(path: str) -> dict:
+    """Scalar vs FM vs wide&deep tile steps timed INTERLEAVED in the
+    same windows: the shared chip's minute-scale contention hits all
+    three equally, so the ratios are trustworthy even when the absolute
+    rates are not (the round-5 contention-quantization finding,
+    docs/perf.md). Compiles are shared with the absolute-rate phases
+    via the kernel caches."""
+    import jax
+    from wormhole_tpu.data.crec import PackedFeed, read_header2
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.models.fm import FMConfig, FMStore
+    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
+    from wormhole_tpu.ops.penalty import L1L2
+    info = read_header2(path)
+    blocks = []
+    for dev, _h, _r in PackedFeed(path, 0, 1, fmt="crec2"):
+        blocks.append(dev)
+        if len(blocks) >= 2:
+            break
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    stores = {
+        "scalar": ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS,
+                                           loss="logit"), handle),
+        "fm": FMStore(FMConfig(num_buckets=NUM_BUCKETS, dim=8)),
+        "wd": WideDeepStore(WideDeepConfig(num_buckets=NUM_BUCKETS,
+                                           dim=16, hidden=(64, 32))),
+    }
+
+    def run(store, steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            store.tile_train_step(blocks[i % len(blocks)], info)
+        jax.block_until_ready(store.slots)
+        float(np.asarray(store.slots[0, 0]))
+        return time.perf_counter() - t0
+
+    for s in stores.values():
+        run(s, 2)                      # compile/warm
+    # ratio PER interleaved pass, then the median: a per-store min could
+    # pair timings from different contention bursts — the very error the
+    # interleaving exists to exclude
+    fm_r, wd_r = [], []
+    for _ in range(5):
+        t = {k: run(s, 4) / 4 for k, s in stores.items()}
+        fm_r.append(t["fm"] / t["scalar"])
+        wd_r.append(t["wd"] / t["scalar"])
+    fm_r.sort()
+    wd_r.sort()
+    return {"fm_step_over_scalar": round(fm_r[len(fm_r) // 2], 2),
+            "wd_step_over_scalar": round(wd_r[len(wd_r) // 2], 2)}
+
+
 def bench_kmeans() -> dict:
     """k-means iteration time at the MNIST-784 shape (BASELINE.json's
     learn/kmeans config: dense 60000 x 784, k=10). One BSP iteration =
@@ -660,6 +713,8 @@ def main() -> None:
     fm = _phase("device_fm", lambda: bench_device_fm(crec2_path))
     wd = _phase("device_wide_deep",
                 lambda: bench_device_wide_deep(crec2_path))
+    ratios = _phase("channel_ratios",
+                    lambda: bench_channel_ratios(crec2_path))
     sparse = _phase("device_sparse", bench_device_sparse)
     dense = _phase("device_dense_apply", bench_device_dense_apply)
     scale = _phase("scale_curve", lambda: bench_scale_curve(workdir, rng))
@@ -700,6 +755,7 @@ def main() -> None:
             "device_step_dense_apply_examples_per_sec": round(dense, 1),
             "device_step_fm_examples_per_sec": round(fm, 1),
             "device_step_wide_deep_examples_per_sec": round(wd, 1),
+            "channel_step_ratios_same_window": ratios,
             "scale_curve_tile_step": scale,
             "kmeans_mnist784": {k: (round(v, 4) if isinstance(v, float)
                                     else v) for k, v in kmeans.items()},

@@ -32,8 +32,10 @@ def _force(o):
 
 
 def build_variant(cap: int, tb: int, rng):
+    # fuse=1: swept tiles_step values need not divide an auto-picked fuse
     spec = dataclasses.replace(
-        tilemm.make_spec(NB, ROWS // tilemm.RSUB, cap), tiles_step=tb)
+        tilemm.make_spec(NB, ROWS // tilemm.RSUB, cap), tiles_step=tb,
+        fuse=1)
     buckets = rng.integers(0, NB, size=ROWS * NNZ, dtype=np.int64)
     rows = np.repeat(np.arange(ROWS, dtype=np.int64), NNZ)
     pw_np, ovb, ovr = tilemm.encode_block(buckets, rows, spec)

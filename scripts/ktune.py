@@ -86,7 +86,7 @@ def main():
           f"tot {tot*1e3:.2f} ms -> {ROWS/tot/1e6:.2f} M ex/s")
 
     for tb in tbs:
-        sp = dataclasses.replace(spec, tiles_step=tb)
+        sp = dataclasses.replace(spec, tiles_step=tb, fuse=1)
         f2, b2 = tilemm._build_fwd(sp), tilemm._build_bwd(sp)
         t_f = timeit(f2, pw, w, reps=reps)
         t_b = timeit(b2, pw, dual, reps=reps)

@@ -231,6 +231,40 @@ def test_mesh_tile_step_large_nb_cap_floor():
     assert err < 2e-2, err
 
 
+def test_fused_tiles_match_unfused_and_oracle():
+    """The K-tile fused bwd kernel (high-nb regime) must match the
+    unfused kernels bit-for-bit (same bf16 arithmetic, same pairs — only
+    the chain view changes) and the exact oracle to bf16 rounding; pad
+    words must stay inert through the joint-digit dual gather (their
+    rhi field gathers a dual row, but the hi one-hot zeroes the
+    histogram column)."""
+    import dataclasses
+    import jax
+    rng = np.random.default_rng(17)
+    nb = 32 * tilemm.TILE
+    spec = tilemm.make_spec(nb, subblocks=4, cap=128)
+    assert spec.fuse > 1, spec       # the regime this test exists for
+    unfused = dataclasses.replace(spec, fuse=1)
+    n_pairs = 12_000                 # ~94 per (subblock, tile): pad-heavy
+    buckets, rows = make_pairs(rng, n_pairs, spec)
+    pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+    assert not len(ovb)
+    w = rng.standard_normal(nb).astype(np.float32)
+    dual = rng.standard_normal(spec.block_rows).astype(np.float32)
+
+    mg_f = np.asarray(tilemm._build_fwd(spec)(pw, w))
+    mg_u = np.asarray(tilemm._build_fwd(unfused)(pw, w))
+    np.testing.assert_array_equal(mg_f, mg_u)
+    g_f = np.asarray(tilemm._build_bwd(spec)(pw, dual))
+    g_u = np.asarray(tilemm._build_bwd(unfused)(pw, dual))
+    np.testing.assert_array_equal(g_f, g_u)
+
+    om = tilemm.forward_margins_ref(buckets, rows, w, spec.block_rows)
+    og = tilemm.backward_grad_ref(buckets, rows, dual, nb)
+    assert np.max(np.abs(mg_f - om)) < 5e-2   # bf16-value rounding
+    assert np.max(np.abs(g_f - og)) < 5e-2
+
+
 def test_spec_validation():
     with pytest.raises(ValueError):
         tilemm.TileSpec(nb=1000, subblocks=2, cap=128)

@@ -94,6 +94,12 @@ class TileSpec:
     cap: int             # C: max pairs per (subblock, tile); mult of 128
     group: int = 4       # GS: subblocks batched per pairs-array slice
     tiles_step: int = 4  # TB: tiles per pallas grid step
+    fuse: int = 1        # K: adjacent tiles fused per BWD value chain
+                         # (high-nb regime: chains stay ~4-6K pairs
+                         # long when cap floors at 128; a pure kernel
+                         # view — the pairs bytes are unchanged; fwd
+                         # measured faster per-tile, see the fused
+                         # section comment)
 
     def __post_init__(self):
         if self.nb % TILE:
@@ -105,6 +111,9 @@ class TileSpec:
         if self.tiles % self.tiles_step:
             raise ValueError(f"tiles {self.tiles} not a multiple of "
                              f"tiles_step {self.tiles_step}")
+        if self.fuse > 1 and self.tiles_step % self.fuse:
+            raise ValueError(f"tiles_step {self.tiles_step} not a "
+                             f"multiple of fuse {self.fuse}")
 
     @property
     def tiles(self) -> int:
@@ -127,12 +136,24 @@ def make_spec(nb: int, subblocks: int, cap: int) -> TileSpec:
     """TileSpec with the largest group (<=4) and tiles_step (<=16, the
     measured sweet spot: amortizes grid overhead, still compiles fast)
     that divide the given shape — small files get degenerate but valid
-    batching."""
+    batching. When cap floors leave value chains short (high-nb regime,
+    docs/perf.md "Model-size scaling"), adjacent tiles FUSE in the bwd
+    kernel so its chains stay ~4-6K pairs long."""
     group = max(g for g in (4, 2, 1) if subblocks % g == 0)
     tiles = nb // TILE
     tb = max(t for t in (16, 8, 4, 2, 1) if tiles % t == 0)
+    # fuse only in the deep cap-floor regime (cap <= 256): at cap=384
+    # (nb=2^24 criteo) the unfused kernels measured ~5% faster — the
+    # K-wide fwd one-hot build costs more than the chain savings until
+    # chains are truly short. fuse <= 8: the bwd joint-digit compare
+    # constant is (K*N, GS*RH) i32 (~4 MB at K=8, cap=128) and the
+    # chain intermediates scale with K*N — both must stay VMEM-friendly.
+    fuse = 1
+    if cap <= 256:
+        while (group * cap * fuse * 2 <= 8192 and fuse * 2 <= min(tb, 8)):
+            fuse *= 2
     return TileSpec(nb=nb, subblocks=subblocks, cap=cap, group=group,
-                    tiles_step=tb)
+                    tiles_step=tb, fuse=fuse)
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +312,80 @@ def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref):
             mg_ref[g * GS + j] = mgs[j]
 
 
+# ---------------------------------------------------------------------------
+# fused-tile BWD kernel (high-nb regime: K adjacent tiles per chain)
+# ---------------------------------------------------------------------------
+#
+# When cap floors at 128 (nb >= ~2^25 for criteo-shaped data), per-tile
+# chains are only group*cap = 512 pairs long and per-chain fixed costs
+# multiply into 3*tiles units. Fusing K adjacent tiles into ONE bwd
+# chain (same pairs bytes, re-viewed (T/K, SG, K*N) by an XLA
+# transpose) measured 13-20% faster at nb=2^26: the dual gather runs
+# once per chain against the group's FULL dual grid (GS*RH deep, the
+# joint digit from the in-place compare constant below) and the grad
+# histogram runs once per tile. The same trick on FWD measured 5-18%
+# SLOWER at both 2^24 and 2^26 (the K*128-wide block-diagonal one-hot
+# build outweighs the chain savings; a joint-digit single-matmul
+# histogram did not close the gap) — so fwd always runs the per-tile
+# kernel and `fuse` only gates the bwd view.
+
+
+@lru_cache(maxsize=None)
+def _fused_ghi_const(K: int, N: int, C: int, GS: int) -> np.ndarray:
+    """(K*N, GS*RH) i32: the bwd joint digit (rhi + RH*subblock-in-
+    group, from the chain position's static (p %% N) // C), pre-shifted
+    for the in-place field compare."""
+    p = np.arange(K * N)[:, None]
+    sb = (p % N) // C
+    l = np.arange(GS * RH)[None, :]
+    return ((l - RH * sb) << RHI_SH).astype(np.int32)
+
+
+def _bwd_kernel_fused(spec: TileSpec, pw_ref, dual_ref, ghic_ref,
+                      g_ref):
+    """Fused bwd: the whole (group, K tiles) chain gathers duals in ONE
+    matmul against the group's full dual grid (GS*RH = 256 deep; the
+    joint digit is rhi + RH*subblock-in-group, from the chain position's
+    static (p % N) // C), then the grad histogram splits back per
+    (tile, subblock)."""
+    S, GS, C, K = spec.subblocks, spec.group, spec.cap, spec.fuse
+    N = spec.n
+    KN = K * N
+    ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
+    ghi_const = ghic_ref[...]
+    for ts in range(spec.tiles_step // K):
+        accs = [jnp.zeros((A_HI, B_LO), jnp.float32) for _ in range(K)]
+        for g in range(S // GS):
+            pc = pw_ref[ts, g].astype(jnp.int32)           # (KN,)
+            rep = pc[:, None]                              # one relayout
+            ohghi = ((rep & (RHI_M << RHI_SH))
+                     == ghi_const).astype(jnp.bfloat16)    # (KN, GS*RH)
+            md = jnp.dot(ohghi, dual_ref[g],
+                         preferred_element_type=jnp.float32)
+            dp = jnp.dot(_mask_sel(rep, RLO_SH, RLO_M, md), ones_bcast,
+                         preferred_element_type=jnp.float32)
+            rhs = _mask_sel(rep, LO_SH, LO_M, dp)          # (KN, 128)
+            for f in range(K):
+                # whole-tile grad histogram: one matmul per tile (the
+                # subblock split was pure matmul count)
+                sl = slice(f * N, (f + 1) * N)
+                ohhiT = _ohT_vec(pc[sl], HI_SH, HI_M, A_HI, N)
+                accs[f] += jnp.dot(ohhiT, rhs[sl],
+                                   preferred_element_type=jnp.float32)
+        for f in range(K):
+            g_ref[ts * K + f] = accs[f]
+
+
+def _fused_pairs_view(pw, spec: TileSpec):
+    """(T, SG, N) pairs -> (T/K, SG, K*N): K adjacent tiles' slices
+    side by side in one chain (f-major). An XLA transpose; the crec2
+    bytes are untouched."""
+    T, K = spec.tiles, spec.fuse
+    SG, N = spec.subblocks // spec.group, spec.n
+    return (pw.reshape(T // K, K, SG, N).transpose(0, 2, 1, 3)
+            .reshape(T // K, SG, K * N))
+
+
 BP = 2  # subblocks per bwd value chain: BP * RH = 128, one full-K pass
 
 
@@ -332,16 +427,22 @@ def _bwd_kernel(spec: TileSpec, pw_ref, dual_ref, g_ref):
                 dp = jnp.dot(_mask_sel(rep, RLO_SH, RLO_M, md), ones_bcast,
                              preferred_element_type=jnp.float32)
                 rhs = _mask_sel(rep, LO_SH, LO_M, dp)      # (NC, 128)
-                for j in range(bp):
-                    ohhiT = _ohT_vec(pc[j * C:(j + 1) * C],
-                                     HI_SH, HI_M, A_HI, C)  # pad -> 0 col
-                    acc += jnp.dot(ohhiT, rhs[j * C:(j + 1) * C],
-                                   preferred_element_type=jnp.float32)
+                # grad histogram over the WHOLE chain in one matmul:
+                # the per-tile sum doesn't care which subblock a pair
+                # came from, so the per-subblock split was pure matmul
+                # count (same flops, same one-hot elems, bp x fewer
+                # issues — round-5: tiny-matmul issue count is what
+                # dominates at high tile counts)
+                ohhiT = _ohT_vec(pc, HI_SH, HI_M, A_HI, NC)
+                acc += jnp.dot(ohhiT, rhs,
+                               preferred_element_type=jnp.float32)
         g_ref[tb] = acc
 
 
 @lru_cache(maxsize=None)
 def _build_fwd(spec: TileSpec):
+    # fwd ignores spec.fuse: per-tile chains measured faster in every
+    # fused-fwd A/B (see the fused section comment)
     T, TB = spec.tiles, spec.tiles_step
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
 
@@ -368,8 +469,40 @@ def _build_fwd(spec: TileSpec):
 
 @lru_cache(maxsize=None)
 def _build_bwd(spec: TileSpec):
-    T, TB = spec.tiles, spec.tiles_step
+    T, TB, K = spec.tiles, spec.tiles_step, spec.fuse
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+    GS = spec.group
+
+    if K > 1:
+        @jax.jit
+        def bwd(pw, dual_rows):
+            dg = (dual_rows.reshape(S // GS, GS * RH, RL)
+                  .astype(jnp.bfloat16))
+            pw_k = _fused_pairs_view(pw, spec)
+            ghic = jnp.asarray(_fused_ghi_const(K, N, spec.cap, GS))
+            g = pl.pallas_call(
+                partial(_bwd_kernel_fused, spec),
+                grid=(T // TB,),
+                in_specs=[
+                    pl.BlockSpec((TB // K, SG, K * N),
+                                 lambda t: (t, 0, 0)),
+                    pl.BlockSpec((S // GS, GS * RH, RL),
+                                 lambda t: (0, 0, 0)),
+                    pl.BlockSpec((K * N, GS * RH),
+                                 lambda t: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((TB, A_HI, B_LO),
+                                       lambda t: (t, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct((T, A_HI, B_LO),
+                                               jnp.float32),
+                compiler_params=None if _interpret()
+                else pltpu.CompilerParams(
+                    vmem_limit_bytes=100 * 1024 * 1024),
+                interpret=_interpret(),
+            )(pw_k, dg, ghic)
+            return g.reshape(spec.nb)
+
+        return bwd
 
     bp = _bp(spec)
 
@@ -501,8 +634,6 @@ def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
                 cond_rlo = _wide_cond(rep, RLO_SH, RLO_M, NC,
                                       ch * RL, RL)
                 cond_lo = _wide_cond(rep, LO_SH, LO_M, NC, ch * 128, 128)
-                ohhiTs = [_ohT_vec(pc[j * C:(j + 1) * C], HI_SH, HI_M,
-                                   A_HI, C) for j in range(bp)]
                 # batched dual gather: all channels in one matmul
                 md_all = jnp.dot(ohghi, dual_ref[sp],
                                  preferred_element_type=jnp.float32)
@@ -512,9 +643,11 @@ def _bwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, dual_ref, g_ref):
                              preferred_element_type=jnp.float32)
                      for jc in range(ch)], axis=1)         # (NC, ch*128)
                 rhs = _mask_where(cond_lo, dp_all)
-                for j in range(bp):
-                    acc += jnp.dot(ohhiTs[j], rhs[j * C:(j + 1) * C],
-                                   preferred_element_type=jnp.float32)
+                # whole-chain grad histogram (subblock split was pure
+                # matmul count; see the scalar bwd kernel)
+                ohhiT = _ohT_vec(pc, HI_SH, HI_M, A_HI, NC)
+                acc += jnp.dot(ohhiT, rhs,
+                               preferred_element_type=jnp.float32)
         g_ref[tb] = acc
 
 
@@ -528,7 +661,9 @@ def _multi_spec(spec: TileSpec, ch: int) -> TileSpec:
     import dataclasses
     tb = max((t for t in (16, 8, 4, 2)
               if spec.tiles % t == 0 and t * (ch + 6) <= 128), default=1)
-    return dataclasses.replace(spec, tiles_step=tb)
+    # fuse=1: the multi-channel kernels keep per-tile chains (their
+    # channel batching already amortizes the per-chain fixed cost)
+    return dataclasses.replace(spec, tiles_step=tb, fuse=1)
 
 
 @lru_cache(maxsize=None)

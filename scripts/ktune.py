@@ -86,7 +86,10 @@ def main():
           f"tot {tot*1e3:.2f} ms -> {ROWS/tot/1e6:.2f} M ex/s")
 
     for tb in tbs:
-        sp = dataclasses.replace(spec, tiles_step=tb, fuse=1)
+        f = spec.fuse            # keep the production fuse when tb
+        while f > 1 and tb % f:  # allows it, else largest divisor —
+            f //= 2              # sweep rows stay comparable to base
+        sp = dataclasses.replace(spec, tiles_step=tb, fuse=f)
         f2, b2 = tilemm._build_fwd(sp), tilemm._build_bwd(sp)
         t_f = timeit(f2, pw, w, reps=reps)
         t_b = timeit(b2, pw, dual, reps=reps)

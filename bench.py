@@ -563,12 +563,26 @@ def bench_lbfgs() -> dict:
     w0 = jnp.zeros(F, jnp.float32)
     warm = LBFGSSolver(LBFGSConfig(memory=10, max_iter=2), obj)
     warm.run(w0)                      # compile grad/objv/directional
+    # full-data CalcGrad alone (pure device work, one D2H): the stable
+    # anchor — the full iteration below includes the host-side line
+    # search whose per-alpha D2H round trips balloon under transport
+    # contention (observed 0.7 vs 15.8 s/iter an hour apart)
+    def one_grad():
+        t0 = time.perf_counter()
+        _, g = obj.calc_grad(w0)
+        jax.block_until_ready(g)
+        float(np.asarray(g.ravel()[0]))
+        return time.perf_counter() - t0
+
+    one_grad()                        # warm
+    grad_s = _median_window(one_grad)
     iters = 8
     solver = LBFGSSolver(LBFGSConfig(memory=10, max_iter=iters), obj)
     t0 = time.perf_counter()
     solver.run(w0)
     it_s = (time.perf_counter() - t0) / max(len(solver.history), 1)
-    return {"iter_sec": it_s, "shape": [n, F, nnz]}
+    return {"iter_sec": it_s, "calc_grad_sec": grad_s,
+            "shape": [n, F, nnz]}
 
 
 def bench_gbdt() -> dict:

@@ -280,6 +280,34 @@ def test_s3_unconfigured_is_informative(monkeypatch):
     with pytest.raises(PermissionError, match="AWS_ACCESS_KEY_ID"):
         S3FileSystem().size("s3://nobody/nothing")
 
+def test_s3_crashed_writer_publishes_nothing(s3):
+    """A with-block exception mid-write to s3:// must NOT publish the
+    buffered partial object (the write buffer aborts the PUT-on-close;
+    VERDICT/ADVICE r4: a crashed CRec2Writer would otherwise upload a
+    truncated-but-complete-looking dataset)."""
+    from wormhole_tpu.data.crec import CRec2Writer
+    from wormhole_tpu.ops import tilemm
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 1 << 31, size=(256, 4), dtype=np.uint32)
+    with pytest.raises(RuntimeError):
+        with CRec2Writer("s3://bkt/crash.crec2", nnz=4,
+                         nb=tilemm.TILE, subblocks=1) as w:
+            w.append(keys, np.zeros(256, np.uint8))
+            raise RuntimeError("mid-conversion crash")
+    assert "bkt/crash.crec2" not in s3.store["objects"], (
+        "partial object was published")
+    # plain open_stream writers abort the same way — including TEXT
+    # mode, whose TextIOWrapper view forwards the exception to the
+    # buffer's abort (AbortingTextWrapper; a bare TextIOWrapper would
+    # flush-and-publish on close)
+    for mode, payload in (("wb", b"partial"), ("w", "partial")):
+        with pytest.raises(RuntimeError):
+            with open_stream(f"s3://bkt/crash.{mode}", mode) as f:
+                f.write(payload)
+                raise RuntimeError("boom")
+        assert f"bkt/crash.{mode}" not in s3.store["objects"]
+
+
 
 # ---------------------------------------------------------------------------
 # fake WebHDFS server (NameNode + DataNode roles in one)

@@ -40,8 +40,8 @@ import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from wormhole_tpu.data.stream import (FileInfo, FileSystem,
-                                      RangedReadStream,
+from wormhole_tpu.data.stream import (AbortingTextWrapper, FileInfo,
+                                      FileSystem, RangedReadStream,
                                       UploadOnCloseBuffer)
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
@@ -194,7 +194,7 @@ class S3FileSystem(FileSystem):
             if "a" in mode:
                 raise ValueError("s3:// streams do not support append")
             raw = _S3WriteBuffer(self, bucket, key)
-            return raw if "b" in mode else io.TextIOWrapper(raw)
+            return raw if "b" in mode else AbortingTextWrapper(raw)
         raw = _S3ReadStream(self, bucket, key)
         buf = io.BufferedReader(raw, buffer_size=self.cfg.read_chunk)
         return buf if "b" in mode else io.TextIOWrapper(buf)

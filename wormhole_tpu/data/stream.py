@@ -157,14 +157,31 @@ class UploadOnCloseBuffer(io.BytesIO):
             super().close()
 
 
+class AbortingTextWrapper(io.TextIOWrapper):
+    """Text view over an UploadOnCloseBuffer that forwards with-block
+    exceptions to the buffer's abort(): io.TextIOWrapper.__exit__ alone
+    just close()s, which would flush and PUBLISH a crashed text-mode
+    writer's partial object."""
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None and hasattr(self.buffer, "abort"):
+            self.buffer.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+
 def discard_output(f) -> None:
     """Writer error-path helper: invalidate a partially-written output
     so it can never read as a truncated-but-complete-looking file.
-    Remote upload buffers abort (nothing publishes); local files
-    truncate to zero bytes (a later reader fails the header parse
-    loudly instead of consuming a silently shorter dataset)."""
+    Remote upload buffers abort (nothing publishes; text-mode wrappers
+    forward to their underlying buffer); local files truncate to zero
+    bytes (a later reader fails the header parse loudly instead of
+    consuming a silently shorter dataset)."""
     if hasattr(f, "abort"):
         f.abort()
+        return
+    inner = getattr(f, "buffer", None)
+    if inner is not None and hasattr(inner, "abort"):
+        inner.abort()
         return
     try:
         f.seek(0)

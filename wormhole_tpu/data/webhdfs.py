@@ -25,7 +25,9 @@ import os
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
-from wormhole_tpu.data.stream import (FileInfo, FileSystem,
+from wormhole_tpu.data.stream import (AbortingTextWrapper,
+                                      FileInfo,
+                                      FileSystem,
                                       RangedReadStream,
                                       UploadOnCloseBuffer)
 
@@ -93,7 +95,7 @@ class WebHDFSFileSystem(FileSystem):
             if "a" in mode:
                 raise ValueError("hdfs:// streams do not support append")
             raw = _HDFSWriteBuffer(self, host, port, path)
-            return raw if "b" in mode else io.TextIOWrapper(raw)
+            return raw if "b" in mode else AbortingTextWrapper(raw)
         raw = _HDFSReadStream(self, host, port, path)
         buf = io.BufferedReader(raw, buffer_size=8 << 20)
         return buf if "b" in mode else io.TextIOWrapper(buf)

@@ -295,17 +295,32 @@ def bench_device_sparse() -> float:
     return 30 * MINIBATCH / elapsed
 
 
-def bench_device_tile(path: str) -> dict:
+def make_tile_stores() -> dict:
+    """One store per tile-step flavor, shared by the absolute-rate
+    phases AND bench_channel_ratios — each store's fused step compiles
+    once per bench run instead of once per phase (the per-instance jit
+    caches cost ~6 min of duplicate remote compiles otherwise)."""
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.models.fm import FMConfig, FMStore
+    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
+    from wormhole_tpu.ops.penalty import L1L2
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    return {
+        "scalar": ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS,
+                                           loss="logit"), handle),
+        "fm": FMStore(FMConfig(num_buckets=NUM_BUCKETS, dim=8)),
+        "wd": WideDeepStore(WideDeepConfig(num_buckets=NUM_BUCKETS,
+                                           dim=16, hidden=(64, 32))),
+    }
+
+
+def bench_device_tile(path: str, store=None) -> dict:
     """The tile-matmul step on HBM-resident crec2 blocks; overhead-
     cancelled timing (t(2N)-t(N))/N with a forced D2H read."""
     import jax
     from wormhole_tpu.data.crec import PackedFeed, read_header2
-    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
-    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
-    from wormhole_tpu.ops.penalty import L1L2
-    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
-    store = ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS, loss="logit"),
-                         handle)
+    store = store if store is not None else make_tile_stores()["scalar"]
     info = read_header2(path)
     blocks = []
     for dev, _host, _rows in PackedFeed(path, 0, 1, fmt="crec2"):
@@ -345,14 +360,13 @@ def bench_device_tile(path: str) -> dict:
             "step_bytes": step_bytes}
 
 
-def bench_device_fm(path: str) -> float:
+def bench_device_fm(path: str, store=None) -> float:
     """The FM (k=8) multi-channel tile step on HBM-resident crec2
     blocks — the stretch-model fast path (pooled pulls + split pushes,
     ops/tilemm multi-channel kernels)."""
     import jax
     from wormhole_tpu.data.crec import PackedFeed, read_header2
-    from wormhole_tpu.models.fm import FMConfig, FMStore
-    store = FMStore(FMConfig(num_buckets=NUM_BUCKETS, dim=8))
+    store = store if store is not None else make_tile_stores()["fm"]
     info = read_header2(path)
     blocks = []
     for dev, _host, _rows in PackedFeed(path, 0, 1, fmt="crec2"):
@@ -376,14 +390,12 @@ def bench_device_fm(path: str) -> float:
     return info.block_rows / per_step
 
 
-def bench_device_wide_deep(path: str) -> float:
+def bench_device_wide_deep(path: str, store=None) -> float:
     """The wide&deep multi-channel tile step on HBM-resident crec2
     blocks (wide scalar + pooled embedding pulls feeding the MLP)."""
     import jax
     from wormhole_tpu.data.crec import PackedFeed, read_header2
-    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
-    store = WideDeepStore(WideDeepConfig(num_buckets=NUM_BUCKETS, dim=16,
-                                         hidden=(64, 32)))
+    store = store if store is not None else make_tile_stores()["wd"]
     info = read_header2(path)
     blocks = []
     for dev, _host, _rows in PackedFeed(path, 0, 1, fmt="crec2"):
@@ -446,34 +458,22 @@ def bench_device_dense_apply() -> float:
     return R / per_step
 
 
-def bench_channel_ratios(path: str) -> dict:
+def bench_channel_ratios(path: str, stores=None) -> dict:
     """Scalar vs FM vs wide&deep tile steps timed INTERLEAVED in the
     same windows: the shared chip's minute-scale contention hits all
     three equally, so the ratios are trustworthy even when the absolute
     rates are not (the round-5 contention-quantization finding,
-    docs/perf.md). Compiles are shared with the absolute-rate phases
-    via the kernel caches."""
+    docs/perf.md). Pass the stores the absolute-rate phases used so
+    their compiled steps are reused."""
     import jax
     from wormhole_tpu.data.crec import PackedFeed, read_header2
-    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
-    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
-    from wormhole_tpu.models.fm import FMConfig, FMStore
-    from wormhole_tpu.models.wide_deep import WideDeepConfig, WideDeepStore
-    from wormhole_tpu.ops.penalty import L1L2
     info = read_header2(path)
     blocks = []
     for dev, _h, _r in PackedFeed(path, 0, 1, fmt="crec2"):
         blocks.append(dev)
         if len(blocks) >= 2:
             break
-    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
-    stores = {
-        "scalar": ShardedStore(StoreConfig(num_buckets=NUM_BUCKETS,
-                                           loss="logit"), handle),
-        "fm": FMStore(FMConfig(num_buckets=NUM_BUCKETS, dim=8)),
-        "wd": WideDeepStore(WideDeepConfig(num_buckets=NUM_BUCKETS,
-                                           dim=16, hidden=(64, 32))),
-    }
+    stores = stores if stores is not None else make_tile_stores()
 
     def run(store, steps):
         t0 = time.perf_counter()
@@ -723,14 +723,19 @@ def main() -> None:
         return out
 
     e2e = _phase("e2e_crec2", lambda: bench_e2e_crec2(crec2_path))
-    tile = _phase("device_tile", lambda: bench_device_tile(crec2_path))
+    stores = make_tile_stores()    # shared by the next four phases only
+    tile = _phase("device_tile",
+                  lambda: bench_device_tile(crec2_path,
+                                            stores["scalar"]))
     stream = _phase("e2e_stream", lambda: bench_e2e_stream(crec2_path))
     text = _phase("e2e_text", lambda: bench_e2e_text(text_path))
-    fm = _phase("device_fm", lambda: bench_device_fm(crec2_path))
+    fm = _phase("device_fm",
+                lambda: bench_device_fm(crec2_path, stores["fm"]))
     wd = _phase("device_wide_deep",
-                lambda: bench_device_wide_deep(crec2_path))
+                lambda: bench_device_wide_deep(crec2_path, stores["wd"]))
     ratios = _phase("channel_ratios",
-                    lambda: bench_channel_ratios(crec2_path))
+                    lambda: bench_channel_ratios(crec2_path, stores))
+    del stores                     # free the HBM tables for later phases
     sparse = _phase("device_sparse", bench_device_sparse)
     dense = _phase("device_dense_apply", bench_device_dense_apply)
     scale = _phase("scale_curve", lambda: bench_scale_curve(workdir, rng))

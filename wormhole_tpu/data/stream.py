@@ -168,6 +168,20 @@ class AbortingTextWrapper(io.TextIOWrapper):
             self.buffer.abort()
         return super().__exit__(exc_type, exc, tb)
 
+    def __del__(self):
+        # same invariant as UploadOnCloseBuffer.__del__: a GC-time close
+        # (writer dropped without close(), e.g. an exception with no
+        # with-block) must never publish the buffered partial object
+        try:
+            if hasattr(self.buffer, "abort"):
+                self.buffer.abort()
+        except ValueError:
+            pass   # buffer already detached/closed
+        try:
+            super().__del__()
+        except AttributeError:
+            pass
+
 
 def discard_output(f) -> None:
     """Writer error-path helper: invalidate a partially-written output

@@ -202,8 +202,18 @@ def test_pipeline_profile_collected(rng, tmp_path):
     from wormhole_tpu.learners.async_sgd import AsyncSGD
     path = str(tmp_path / "train.libsvm")
     write_libsvm(path, rng, n=100, f=30)
+    # pipelined feed (default): localize folds into the worker pad stage,
+    # and the DeviceFeed stall counters join the profile
     app = AsyncSGD(Config(train_data=path, minibatch=50, max_data_pass=1,
                           num_buckets=NB, disp_itv=1e9),
+                   MeshRuntime.create())
+    app.run()
+    for stage in ("parse", "pad", "put", "feed_stall", "dispatch", "wait"):
+        assert stage in app.timer.totals, app.timer.totals
+    # serial fallback keeps the historical inline stage names
+    app = AsyncSGD(Config(train_data=path, minibatch=50, max_data_pass=1,
+                          num_buckets=NB, disp_itv=1e9,
+                          pipeline_workers=0),
                    MeshRuntime.create())
     app.run()
     for stage in ("parse", "localize", "pad", "dispatch", "wait"):

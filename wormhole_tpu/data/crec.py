@@ -162,6 +162,36 @@ def _part_block_range(info: CRecInfo, part: int, nparts: int) -> range:
     return range(lo, hi)
 
 
+def _read_block(f, path: str, info: CRecInfo, i: int,
+                pad_tail: bool = True) -> Tuple[np.ndarray, int]:
+    """Read one v1 block at its seek offset — safe to call from several
+    threads as long as each holds its OWN stream handle (blocks are
+    independent fixed-size seekable ranges)."""
+    full = info.block_bytes
+    rows = info.rows_in_block(i)
+    nbytes = info.block_nbytes(i)
+    f.seek(info.block_offset(i))
+    if rows == info.block_rows:
+        buf = np.empty(full, np.uint8)
+        got = f.readinto(memoryview(buf))
+        if got != full:
+            raise IOError(f"{path}: truncated block {i}")
+        return buf, rows
+    raw = f.read(nbytes)
+    if len(raw) != nbytes:
+        raise IOError(f"{path}: truncated tail block {i}")
+    if not pad_tail:
+        return np.frombuffer(raw, np.uint8).copy(), rows
+    buf = np.empty(full, np.uint8)
+    kb = rows * info.nnz * 4
+    kb_full = info.block_rows * info.nnz * 4
+    buf[:kb] = np.frombuffer(raw, np.uint8, kb)
+    buf[kb:kb_full] = 0xFF          # sentinel keys
+    buf[kb_full:kb_full + rows] = np.frombuffer(raw, np.uint8, rows, kb)
+    buf[kb_full + rows:] = PAD_LABEL
+    return buf, rows
+
+
 def iter_packed(path: str, part: int = 0, nparts: int = 1,
                 pad_tail: bool = True) -> Iterator[Tuple[np.ndarray, int]]:
     """Yield ``(packed_u8, rows)`` per owned block.
@@ -173,35 +203,10 @@ def iter_packed(path: str, part: int = 0, nparts: int = 1,
     blocks = _part_block_range(info, part, nparts)
     if not len(blocks):
         return
-    full = info.block_bytes
     from wormhole_tpu.data.stream import open_stream
     with open_stream(path, "rb") as f:
         for i in blocks:
-            rows = info.rows_in_block(i)
-            nbytes = info.block_nbytes(i)
-            f.seek(info.block_offset(i))
-            if rows == info.block_rows:
-                buf = np.empty(full, np.uint8)
-                got = f.readinto(memoryview(buf))
-                if got != full:
-                    raise IOError(f"{path}: truncated block {i}")
-                yield buf, rows
-            else:
-                raw = f.read(nbytes)
-                if len(raw) != nbytes:
-                    raise IOError(f"{path}: truncated tail block {i}")
-                if not pad_tail:
-                    yield np.frombuffer(raw, np.uint8).copy(), rows
-                    continue
-                buf = np.empty(full, np.uint8)
-                kb = rows * info.nnz * 4
-                kb_full = info.block_rows * info.nnz * 4
-                buf[:kb] = np.frombuffer(raw, np.uint8, kb)
-                buf[kb:kb_full] = 0xFF          # sentinel keys
-                buf[kb_full:kb_full + rows] = np.frombuffer(raw, np.uint8,
-                                                            rows, kb)
-                buf[kb_full + rows:] = PAD_LABEL
-                yield buf, rows
+            yield _read_block(f, path, info, i, pad_tail)
 
 
 def unpack_block(packed: np.ndarray,
@@ -415,6 +420,19 @@ def block2_views(info: CRec2Info, buf: np.ndarray) -> dict:
     }
 
 
+def _read_block2(f, path: str, info: CRec2Info,
+                 i: int) -> Tuple[dict, int]:
+    """Read one v2 block (same per-thread-handle contract as
+    ``_read_block``; all blocks fixed-size, writer already padded the
+    tail)."""
+    size = info.block_bytes
+    f.seek(info.block_offset(i))
+    buf = np.empty(size, np.uint8)
+    if f.readinto(memoryview(buf)) != size:
+        raise IOError(f"{path}: truncated block {i}")
+    return block2_views(info, buf), info.rows_in_block(i)
+
+
 def iter_packed2(path: str, part: int = 0,
                  nparts: int = 1) -> Iterator[Tuple[dict, int]]:
     """Yield ``(views_dict, rows)`` per owned v2 block (all fixed-size;
@@ -423,15 +441,10 @@ def iter_packed2(path: str, part: int = 0,
     nb_blocks = info.num_blocks
     lo = part * nb_blocks // nparts
     hi = (part + 1) * nb_blocks // nparts
-    size = info.block_bytes
     from wormhole_tpu.data.stream import open_stream
     with open_stream(path, "rb") as f:
         for i in range(lo, hi):
-            f.seek(info.block_offset(i))
-            buf = np.empty(size, np.uint8)
-            if f.readinto(memoryview(buf)) != size:
-                raise IOError(f"{path}: truncated block {i}")
-            yield block2_views(info, buf), info.rows_in_block(i)
+            yield _read_block2(f, path, info, i)
 
 
 class PackedFeed:
@@ -449,10 +462,11 @@ class PackedFeed:
 
     def __init__(self, path: str, part: int = 0, nparts: int = 1,
                  depth: int = 3, device_put=None, fmt: str = "crec",
-                 cache: bool = False):
+                 cache: bool = False, workers: int = 0):
         self.path, self.part, self.nparts = path, part, nparts
         self.fmt = fmt
         self.depth = depth
+        self.workers = workers
         self.read_time = 0.0
         self.put_time = 0.0
         self.bytes_read = 0
@@ -460,6 +474,7 @@ class PackedFeed:
         self._iter_blocks = iter_packed if fmt == "crec" else iter_packed2
         self._cache: Optional[list] = [] if cache else None
         self._cache_full = False
+        self._pipe = None  # last DeviceFeed, for stall-counter draining
 
     def _labels_only(self, packed) -> np.ndarray:
         """Host labels slice of a block — the only host-side bytes any
@@ -477,7 +492,38 @@ class PackedFeed:
             return
         yield from self._stream()
 
+    def drain_pipe_stats(self, timer, prefix: str = "") -> Optional[dict]:
+        """Merge the last pipelined stream's stage/stall counters into
+        ``timer`` (no-op for serial streams)."""
+        pipe, self._pipe = self._pipe, None
+        return pipe.drain_stats(timer, prefix) if pipe is not None else None
+
     def _stream(self):
+        try:
+            items = (self._stream_pipelined() if self.workers > 0
+                     else self._stream_serial())
+            for item in items:
+                if self._cache is not None:
+                    dev, packed, rows = item
+                    self._cache.append((dev, self._labels_only(packed),
+                                        rows))
+                yield item
+            if self._cache is not None:
+                self._cache_full = True
+        finally:
+            if self._cache is not None and not self._cache_full:
+                # a partial iteration (error or early consumer exit) must
+                # not leave a half-filled cache that a retry would extend
+                # into duplicated blocks
+                self._cache = []
+
+    def _account(self, packed) -> None:
+        if isinstance(packed, dict):
+            self.bytes_read += sum(v.nbytes for v in packed.values())
+        else:
+            self.bytes_read += packed.nbytes
+
+    def _stream_serial(self):
         import time as _time
         import jax
         put = self._device_put or jax.device_put
@@ -503,11 +549,7 @@ class PackedFeed:
                     t0 = _time.perf_counter()
                     dev = put(packed)
                     self.put_time += _time.perf_counter() - t0
-                    if isinstance(packed, dict):
-                        self.bytes_read += sum(v.nbytes
-                                               for v in packed.values())
-                    else:
-                        self.bytes_read += packed.nbytes
+                    self._account(packed)
                     if not _put_or_stop((dev, packed, rows)):
                         return
             except BaseException as e:
@@ -524,20 +566,73 @@ class PackedFeed:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                if self._cache is not None:
-                    dev, packed, rows = item
-                    self._cache.append((dev, self._labels_only(packed),
-                                        rows))
                 yield item
-            if self._cache is not None:
-                self._cache_full = True
         finally:
             stop.set()
-            if self._cache is not None and not self._cache_full:
-                # a partial iteration (error or early consumer exit) must
-                # not leave a half-filled cache that a retry would extend
-                # into duplicated blocks
-                self._cache = []
+
+    def _pipeline_spec(self):
+        """(source, prep, collate, on_close) for the parallel read path:
+        block indices dispatch to workers that each read with their OWN
+        stream handle (crec blocks are independent fixed-size seekable
+        ranges, so block-index parallelism is exact)."""
+        from wormhole_tpu.data.stream import open_stream
+        if self.fmt == "crec":
+            info = read_header(self.path)
+            reader = _read_block
+        else:
+            info = read_header2(self.path)
+            reader = _read_block2
+        nb = info.num_blocks
+        lo = self.part * nb // self.nparts
+        hi = (self.part + 1) * nb // self.nparts
+        tls = threading.local()
+        handles: list = []
+        hlock = threading.Lock()
+
+        def prep(i, _ctx):
+            f = getattr(tls, "f", None)
+            if f is None:
+                f = tls.f = open_stream(self.path, "rb")
+                with hlock:
+                    handles.append(f)
+            return reader(f, self.path, info, i)
+
+        def on_close():
+            with hlock:
+                for f in handles:
+                    try:
+                        f.close()
+                    except Exception:
+                        pass
+                handles.clear()
+
+        return iter(range(lo, hi)), prep, None, on_close
+
+    def _stream_pipelined(self):
+        """DeviceFeed-backed stream: parallel block reads/assembly, one
+        in-order transfer thread keeping ``depth`` device-resident blocks
+        ahead of the consumer. Yields the same ``(dev, host, rows)``
+        triples, in the same order, as the serial stream."""
+        import time as _time
+        import jax
+        from wormhole_tpu.data.pipeline import DeviceFeed
+        put = self._device_put or jax.device_put
+
+        def transfer(pr):
+            packed, rows = pr
+            t0 = _time.perf_counter()
+            dev = put(packed)
+            self.put_time += _time.perf_counter() - t0
+            self._account(packed)
+            return dev, packed, rows
+
+        source, prep, collate, on_close = self._pipeline_spec()
+        feed = DeviceFeed(source, prep, workers=self.workers,
+                          ring_depth=self.depth, collate=collate,
+                          transfer=transfer, on_close=on_close,
+                          name=f"{self.fmt}-feed")
+        self._pipe = feed
+        yield from feed
 
 
 def _python_crec_assembler(fmt: str, nnz: int):
@@ -577,9 +672,11 @@ class TextCRecFeed(PackedFeed):
 
     def __init__(self, path: str, part: int = 0, nparts: int = 1, *,
                  text_fmt: str, nnz: int, block_rows: int = 16384,
-                 depth: int = 3, device_put=None, cache: bool = False):
+                 depth: int = 3, device_put=None, cache: bool = False,
+                 workers: int = 0):
         super().__init__(path, part, nparts, depth=depth,
-                         device_put=device_put, fmt="crec", cache=cache)
+                         device_put=device_put, fmt="crec", cache=cache,
+                         workers=workers)
         self.text_fmt = text_fmt
         self.nnz = nnz
         self.block_rows = block_rows
@@ -596,17 +693,33 @@ class TextCRecFeed(PackedFeed):
         out[kb:] = lbuf
         return out
 
-    def _text_blocks(self, path: str, part: int, nparts: int):
+    def _assembler(self):
         from wormhole_tpu.data import native
-        from wormhole_tpu.data.input_split import InputSplit
-        asm = (native.get_crec_assembler(self.text_fmt, self.nnz)
-               or _python_crec_assembler(self.text_fmt, self.nnz))
+        return (native.get_crec_assembler(self.text_fmt, self.nnz)
+                or _python_crec_assembler(self.text_fmt, self.nnz))
+
+    def _block_collator(self):
+        """Sequential (keys, labels) → fixed-R-row packed-block folding;
+        shared by the serial stream and the pipeline's collate stage
+        (which runs it in stream order on the transfer thread).
+        ``fold(res)`` returns the finished blocks; ``fold(None)`` flushes
+        the padded tail."""
         R = self.block_rows
         kbuf = np.empty((R, self.nnz), np.uint32)
         lbuf = np.empty(R, np.uint8)
-        fill = 0
-        for chunk in InputSplit(path, part, nparts, "text"):
-            keys, labels = asm(bytes(chunk))
+        state = {"fill": 0}
+
+        def fold(res):
+            out = []
+            fill = state["fill"]
+            if res is None:
+                if fill:
+                    kbuf[fill:] = SENTINEL_KEY
+                    lbuf[fill:] = PAD_LABEL
+                    out.append((self._pack(kbuf, lbuf), fill))
+                    state["fill"] = 0
+                return out
+            keys, labels = res
             pos = 0
             while pos < len(labels):
                 take = min(len(labels) - pos, R - fill)
@@ -615,9 +728,39 @@ class TextCRecFeed(PackedFeed):
                 fill += take
                 pos += take
                 if fill == R:
-                    yield self._pack(kbuf, lbuf), R
+                    out.append((self._pack(kbuf, lbuf), R))
                     fill = 0
-        if fill:
-            kbuf[fill:] = SENTINEL_KEY
-            lbuf[fill:] = PAD_LABEL
-            yield self._pack(kbuf, lbuf), fill
+            state["fill"] = fill
+            return out
+
+        return fold
+
+    def _text_blocks(self, path: str, part: int, nparts: int):
+        from wormhole_tpu.data.input_split import InputSplit
+        asm = self._assembler()
+        fold = self._block_collator()
+        for chunk in InputSplit(path, part, nparts, "text"):
+            yield from fold(asm(bytes(chunk)))
+        yield from fold(None)
+
+    def _pipeline_spec(self):
+        """Text path: chunks dispatch to workers running the hot native
+        parse+fold assembly in parallel (wh_parse_to_crec releases the
+        GIL and allocates its own outputs per call); the sequential
+        re-blocking into fixed-row packed blocks runs as the collate
+        stage on the transfer thread, preserving exact block boundaries
+        and order."""
+        from wormhole_tpu.data.input_split import InputSplit
+        asm = self._assembler()
+        fold = self._block_collator()
+        split = InputSplit(self.path, self.part, self.nparts, "text")
+
+        def source():
+            for chunk in split:
+                # bytes() copy here: the split may reuse its chunk buffer
+                yield bytes(chunk)
+
+        def prep(chunk, _ctx):
+            return asm(chunk)
+
+        return source(), prep, fold, None

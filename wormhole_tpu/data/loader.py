@@ -58,13 +58,20 @@ def load_dense_batches(uri: str, rt: MeshRuntime, *,
                        max_nnz: int = 0,
                        feature_multiple: int = 1,
                        part: Optional[int] = None,
-                       nparts: Optional[int] = None) -> LoadedBatches:
+                       nparts: Optional[int] = None,
+                       pipeline_workers: int = 2) -> LoadedBatches:
     """Read part ``rank/world`` of ``uri``, pad, device_put sharded.
 
     ``feature_multiple`` rounds num_features up (model-axis divisibility for
     feature-sharded weights); the padded tail never appears in any cols
     array. Preset ``num_features`` is validated against the data — an
     out-of-range id would otherwise be silently clamped/dropped inside jit.
+
+    The pad + device_put loop runs as a DeviceFeed over ``pipeline_workers``
+    threads (the dense scatter is the hot stage for wide features); 0 keeps
+    the serial loop. Batch order and contents are identical either way —
+    shapes are fully resolved before the fan-out, so workers can't perturb
+    them.
     """
     if part is None or nparts is None:
         part, nparts = rt.local_part()
@@ -82,10 +89,13 @@ def load_dense_batches(uri: str, rt: MeshRuntime, *,
         max_nnz = max((next_bucket(b.max_row_nnz(), 8) for b in blocks),
                       default=8)
     sharding = dense_batch_sharding(rt)
-    batches = []
-    for blk in blocks:
-        db = pad_block_global(blk, minibatch_size, max_nnz)
-        # device_put even when unsharded: batches stay resident in HBM so
-        # every later pass is free of H2D transfer
-        batches.append(jax.device_put(db, sharding))
-    return LoadedBatches(batches, num_features, max_nnz)
+    # device_put even when unsharded: batches stay resident in HBM so
+    # every later pass is free of H2D transfer
+    from wormhole_tpu.data.pipeline import DeviceFeed
+    feed = DeviceFeed(
+        blocks,
+        lambda blk, _ctx: pad_block_global(blk, minibatch_size, max_nnz),
+        workers=pipeline_workers,
+        transfer=lambda db: jax.device_put(db, sharding),
+        name="dense-load")
+    return LoadedBatches(list(feed), num_features, max_nnz)

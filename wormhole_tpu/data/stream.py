@@ -107,16 +107,18 @@ class UploadOnCloseBuffer(io.BytesIO):
     """Local seekable buffer whose contents upload once on close — the
     shared write-side scaffolding of the remote streams. Seekability
     means header-backpatching writers (crec/crec2, BinnedCache) work
-    unchanged. The upload happens exactly once: a failed upload raises to
-    the caller (never silently succeeds) and the buffer is freed either
-    way.
+    unchanged. The upload happens at most once per success: a failed
+    upload raises to the caller (never silently succeeds), REMEMBERS the
+    failure, and keeps the buffer alive so an explicit close() retries
+    the upload — the retry-by-reclose contract. The bytes are only
+    discarded by abort()/with-block-exception/GC, never by a transient
+    upload error.
 
     A with-block that exits on an exception ABORTS the upload (the
     buffered bytes are a half-written object that would otherwise publish
-    as a truncated-but-complete-looking file); and a close() whose upload
-    raises still releases the BytesIO on a later implicit/GC close
-    instead of re-attempting the upload from a destructor at an
-    arbitrary time."""
+    as a truncated-but-complete-looking file); a GC-time close after a
+    failed explicit close() frees the buffer without re-attempting the
+    upload from a destructor at an arbitrary time."""
 
     def __init__(self, upload) -> None:
         """``upload(body: bytes)`` raises on failure."""
@@ -124,6 +126,7 @@ class UploadOnCloseBuffer(io.BytesIO):
         self._upload = upload
         self._done = False
         self._aborted = False
+        self._upload_error = None   # last failed attempt, for retry logs
 
     def abort(self) -> None:
         """Discard the buffered bytes: close() becomes a no-op upload."""
@@ -146,15 +149,21 @@ class UploadOnCloseBuffer(io.BytesIO):
             pass
 
     def close(self) -> None:
-        if not self._done and not self._aborted:
-            self._aborted = True   # one attempt: GC close never re-uploads
-            try:
-                self._upload(self.getvalue())
-                self._done = True
-            finally:
-                super().close()    # a failed upload still frees the buffer
-        else:
+        if self._done or self._aborted:
             super().close()
+            return
+        try:
+            self._upload(self.getvalue())
+        except BaseException as e:
+            # remember the failure and KEEP the buffer open: the caller
+            # retries by calling close() again (a silent no-op here would
+            # drop the write while looking successful). GC still frees
+            # without publishing — __del__ flips _aborted first.
+            self._upload_error = e
+            raise
+        self._done = True
+        self._upload_error = None
+        super().close()
 
 
 class AbortingTextWrapper(io.TextIOWrapper):

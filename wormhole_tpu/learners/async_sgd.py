@@ -107,6 +107,10 @@ class AsyncSGD:
         self.model_monitor = ModelMonitor()
         self.reporter = TimeReporter(self._emit_row, interval=cfg.disp_itv)
         self.timer = Timer()  # pipeline stage profile (SURVEY §5.1)
+        # DeviceFeed counters (data/pipeline.py): cumulative consumer-side
+        # ring stalls, batches delivered, deepest ring occupancy observed
+        self.feed_stats = {"feed_stall": 0.0, "feed_batches": 0,
+                           "ring_max": 0}
         # deferred crec2 metric window: per-step metrics accumulate ON
         # DEVICE (store.fetch_metrics); the host only counts dispatched
         # steps and fetches one buffer at disp_itv / flush — fetching
@@ -120,12 +124,49 @@ class AsyncSGD:
 
     # -- worker data path ---------------------------------------------------
 
+    def _bucket_nnz(self, blk) -> int:
+        """Resolve the (monotone) per-batch nnz bucket for ``blk``.
+
+        MUST be called sequentially in stream order — each batch's bucket
+        is the max over every block up to and including it, so calling it
+        from the pipeline dispatcher (in order, ahead of the pad workers)
+        gives bit-exact parity with the serial path. A denser later batch
+        grows the bucket (one recompile) up to the 4096-entry cap — rows
+        beyond the cap (or beyond a user-set cfg.max_nnz) are positionally
+        truncated, loudly."""
+        densest = blk.max_row_nnz()
+        if not self.cfg.max_nnz:
+            self._max_nnz = max(self._max_nnz, nnz_bucket(densest))
+        if densest > self._max_nnz and not self._warned_trunc:
+            self._warned_trunc = True
+            log.warning(
+                "row with %d features truncated to max_nnz=%d "
+                "(set max_nnz to keep more)", densest, self._max_nnz)
+        return self._max_nnz
+
+    def _localize_pad(self, blk, max_nnz: int):
+        """localize + pad one block (stateless; safe on a worker thread:
+        Localizer.localize only reads config, and the bucket values were
+        resolved sequentially by ``_bucket_nnz``)."""
+        loc = self.localizer.localize(blk)
+        kpad = self.cfg.key_pad or next_bucket(len(loc.uniq_keys), 64)
+        return pad_to_batch(loc, self.cfg.minibatch, max_nnz, kpad)
+
     def _batches(self, file: str, part: int, nparts: int,
                  prefix: str = ""):
-        """stream → localize → pad, with shape bucketing for XLA."""
+        """stream → localize → pad, with shape bucketing for XLA.
+
+        With ``cfg.pipeline_workers > 0`` the stages run as a DeviceFeed
+        (localize+pad on a worker pool, device transfer on its own
+        thread, a ``pipeline_ring``-deep device-resident ring ahead of
+        the compute loop); 0 falls back to the serial in-line path.
+        Batch order, shapes and exceptions are identical either way."""
         cfg = self.cfg
         reader = MinibatchIter(file, part, nparts, cfg.data_format,
                                cfg.minibatch)
+        if cfg.pipeline_workers > 0:
+            yield from self._batches_pipelined(reader, prefix)
+            return
         it = iter(reader)
         while True:
             with self.timer.scope(prefix + "parse"):
@@ -134,24 +175,46 @@ class AsyncSGD:
                 break
             with self.timer.scope(prefix + "localize"):
                 loc = self.localizer.localize(blk)
-            # per-batch nnz bucket, monotone so shapes don't thrash; a denser
-            # later batch grows the bucket (one recompile) up to the 4096-
-            # entry cap — rows beyond the cap (or beyond a user-set
-            # cfg.max_nnz) are positionally truncated, loudly
-            densest = blk.max_row_nnz()
-            if not cfg.max_nnz:
-                self._max_nnz = max(self._max_nnz, nnz_bucket(densest))
-            if densest > self._max_nnz and not self._warned_trunc:
-                self._warned_trunc = True
-                log.warning(
-                    "row with %d features truncated to max_nnz=%d "
-                    "(set max_nnz to keep more)", densest, self._max_nnz)
+            max_nnz = self._bucket_nnz(blk)
             kpad = (self.cfg.key_pad
                     or next_bucket(len(loc.uniq_keys), 64))
             with self.timer.scope(prefix + "pad"):
-                batch = pad_to_batch(loc, cfg.minibatch, self._max_nnz,
-                                     kpad)
+                batch = pad_to_batch(loc, cfg.minibatch, max_nnz, kpad)
             yield batch
+
+    def _batches_pipelined(self, reader: MinibatchIter, prefix: str):
+        from wormhole_tpu.data.pipeline import DeviceFeed
+        cfg = self.cfg
+        # multihost assembles HOST numpy batches into one global array
+        # (_global_batch); transferring to device here would just force a
+        # copy back — keep the identity transfer and let the global
+        # assembly place the data
+        host_only = jax.process_count() > 1
+
+        def transfer(batch):
+            if host_only:
+                return batch
+            dev = jax.device_put(batch)
+            # num_real is a non-pytree attr (pad_to_batch sets it; eval
+            # pooling reads it via _real_rows) — device_put drops it
+            dev.num_real = getattr(batch, "num_real", None)
+            return dev
+
+        feed = DeviceFeed(reader, self._localize_pad,
+                          workers=cfg.pipeline_workers,
+                          ring_depth=cfg.pipeline_ring,
+                          seq_ctx=self._bucket_nnz,
+                          transfer=transfer,
+                          bytes_read=reader.bytes_read,
+                          name=(prefix or "train").rstrip("_"))
+        try:
+            yield from feed
+        finally:
+            snap = feed.drain_stats(self.timer, prefix)
+            self.feed_stats["feed_stall"] += snap["consume_stall"]
+            self.feed_stats["feed_batches"] += snap["batches"]
+            self.feed_stats["ring_max"] = max(self.feed_stats["ring_max"],
+                                              snap["ring_max"])
 
     def process(self, file: str, part: int, nparts: int,
                 kind: str = TRAIN, pooled: Optional[list] = None) -> Progress:
@@ -166,6 +229,7 @@ class AsyncSGD:
                 or self._text_dense():
             return self._process_crec(file, part, nparts, kind, pooled)
         cfg = self.cfg
+        fs0 = dict(self.feed_stats)
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         inflight: deque = deque()
         mon = WorkerMonitor()          # per-part metric accumulation
@@ -212,6 +276,7 @@ class AsyncSGD:
                     b, g, s, m = inflight.popleft()
                     self.store.dt2_push(b, g, s)
                     harvest((m, None, None))
+            self._merge_feed_progress(local, fs0)
             return local
 
         # eval records under its own prefix so the training pipeline
@@ -239,7 +304,36 @@ class AsyncSGD:
         with self.timer.scope(pfx + "wait"):       # WaitMinibatch(0)
             while inflight:
                 harvest(inflight.popleft())
+        self._merge_feed_progress(local, fs0)
         return local
+
+    def _merge_feed_progress(self, local: Progress, before: dict) -> None:
+        """Fold this part's DeviceFeed counter deltas into its Progress
+        row, so feed stalls merge/report like every other metric."""
+        fs = self.feed_stats
+        local.feed_stall += fs["feed_stall"] - before["feed_stall"]
+        local.feed_batches += fs["feed_batches"] - before["feed_batches"]
+
+    def _merge_pipe_snap(self, snap: Optional[dict], pfx: str,
+                         local: Optional[Progress] = None) -> None:
+        """Fold a packed feed's pipeline counters (PackedFeed
+        .drain_pipe_stats) into the stage timer / Progress row. ``put``
+        is excluded — the feed's own put_time accounting already covers
+        the transfer stage on this path."""
+        if not snap:
+            return
+        n = max(snap["batches"], 1)
+        self.timer.add(pfx + "read", snap["prep"], n)
+        self.timer.add(pfx + "feed_stall", snap["consume_stall"], n)
+        self.timer.add(pfx + "read_stall", snap["prep_stall"], n)
+        self.timer.add(pfx + "put_stall", snap["put_stall"], n)
+        self.feed_stats["feed_stall"] += snap["consume_stall"]
+        self.feed_stats["feed_batches"] += snap["batches"]
+        self.feed_stats["ring_max"] = max(self.feed_stats["ring_max"],
+                                          snap["ring_max"])
+        if local is not None:
+            local.feed_stall += snap["consume_stall"]
+            local.feed_batches += snap["batches"]
 
     def _text_dense(self) -> bool:
         """True when this text format streams through the dense-apply
@@ -260,13 +354,17 @@ class AsyncSGD:
     def _make_feed(self, file: str, part: int, nparts: int, fmt: str,
                    device_put=None, cache: bool = False):
         from wormhole_tpu.data.crec import PackedFeed, TextCRecFeed
+        workers = self.cfg.pipeline_workers
+        depth = max(self.cfg.pipeline_ring, 3 if workers == 0 else 1)
         if fmt in ("crec", "crec2"):
             return PackedFeed(file, part, nparts, fmt=fmt, cache=cache,
-                              device_put=device_put)
+                              device_put=device_put, workers=workers,
+                              depth=depth)
         return TextCRecFeed(file, part, nparts, text_fmt=fmt,
                             nnz=self._text_nnz(),
                             block_rows=self.cfg.text_block_rows,
-                            cache=cache, device_put=device_put)
+                            cache=cache, device_put=device_put,
+                            workers=workers, depth=depth)
 
     def _feed(self, file: str, part: int, nparts: int, fmt: str):
         """Feed per (file, part), kept across data passes so cache_device
@@ -511,6 +609,7 @@ class AsyncSGD:
             else:
                 drain_pending()
         self.timer.add(pfx + "put", feed.put_time - put_before)
+        self._merge_pipe_snap(feed.drain_pipe_stats(None), pfx, local)
         return local
 
     def _process_crec_mesh(self, file: str, part: int, nparts: int,
@@ -628,6 +727,7 @@ class AsyncSGD:
         with self.timer.scope(pfx + "wait"):
             drain_pending()
         self.timer.add(pfx + "put", feed.put_time)
+        self._merge_pipe_snap(feed.drain_pipe_stats(None), pfx, local)
         return local
 
     @staticmethod

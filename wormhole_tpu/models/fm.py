@@ -428,3 +428,39 @@ class FMStore(TableCheckpoint):
         slots[:, 1:1 + self.cfg.dim] = data["v"]
         self.slots = jax.device_put(jnp.asarray(slots),
                                     self.slots.sharding)
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m wormhole_tpu.models.fm [conf] train_data=<uri>
+    dim=8 [key=val ...]`` — the AsyncSGD driver with an FMStore plugged
+    in, so FM training streams through the same DeviceFeed ingest
+    pipeline as the linear learner.
+
+    ``key=val`` tokens are routed by field name: FMConfig fields go to
+    the model, everything else to the driver Config. ``num_buckets``,
+    ``loss`` and ``seed`` live on the driver and are mirrored into the
+    model config (AsyncSGD rejects a store whose bucket count disagrees
+    with the driver's)."""
+    import dataclasses as _dc
+    import sys
+
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import apply_kvs, load_config
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    conf = args.pop(0) if args and "=" not in args[0] else None
+    shared = {"num_buckets", "loss", "seed"}
+    model_keys = {f.name for f in _dc.fields(FMConfig)} - shared
+    model_kvs = [a for a in args
+                 if a.partition("=")[0].strip() in model_keys]
+    cfg = load_config(conf, [a for a in args if a not in model_kvs])
+    mcfg = FMConfig(num_buckets=cfg.num_buckets, loss=cfg.loss.value,
+                    seed=cfg.seed)
+    apply_kvs(mcfg, model_kvs)
+    rt = MeshRuntime.create(cfg.mesh_shape)
+    AsyncSGD(cfg, rt, store=FMStore(mcfg, rt)).run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -360,11 +360,21 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
     buf[:len(ids_local)] = ids_local
     gathered = np.asarray(multihost_utils.process_allgather(buf)).ravel()
     feat_ids = np.unique(gathered[gathered >= 0])
-    # deterministic evenly-strided entry sample (no rng: every run of the
-    # same shard contributes the same entries)
+    # deterministic entry sample: fixed-seed shuffle, then even stride.
+    # A bare stride over stream positions is NOT value-neutral — entries
+    # often arrive value-correlated (per-feature sorted dumps, clustered
+    # rows), and a systematic sweep through such a stream aliases against
+    # that ordering, skewing the merged quantile cuts. Permuting first
+    # decorrelates position from value while keeping the sample
+    # reproducible: every run of the same shard contributes the same
+    # entries.
     take = min(len(ev), sample_cap)
-    sel = (np.linspace(0, max(len(ev) - 1, 0), take).astype(np.int64)
-           if take else np.zeros(0, np.int64))
+    if take:
+        perm = np.random.default_rng(0x5EED).permutation(len(ev))
+        sel = np.sort(perm[np.linspace(0, max(len(ev) - 1, 0),
+                                       take).astype(np.int64)])
+    else:
+        sel = np.zeros(0, np.int64)
     cap_max = int(allreduce_tree(np.int64(take), runtime.mesh, "max"))
     ef_buf = np.full(cap_max, -1, np.int64)
     ev_buf = np.zeros(cap_max, np.float32)
@@ -377,7 +387,11 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
     cuts = _entry_quantile_cuts(ef_m, ev_m[keep], len(feat_ids), num_bins)
     # long-tail guard: a feature every host's sample missed gets all-zero
     # cuts (splittable only as present-vs-missing) — flag it so a quiet
-    # accuracy divergence from single-process runs is at least visible
+    # accuracy divergence from single-process runs is at least visible.
+    # Caveat: past sample_cap the cuts come from a uniform (fixed-seed)
+    # subsample per host, so rare features ride on few entries and their
+    # cut positions are approximate even when covered — sample_cap trades
+    # allgather bytes for sketch fidelity.
     uncovered = len(feat_ids) - len(np.unique(ef_m))
     if uncovered:
         log.warning(
@@ -482,9 +496,41 @@ def apply_bins(x: np.ndarray, cuts: np.ndarray) -> np.ndarray:
     return bins
 
 
-# ---------------------------------------------------------------------------
-# booster
-# ---------------------------------------------------------------------------
+def _sweep_stale_caches(tag: str) -> None:
+    """Remove dead-owner ``wh_gbdt_{tag}_*`` cache files from tempdir.
+
+    The default external-memory cache name is pid-keyed, so a process
+    killed between ``BinnedCache.create`` and the removing ``finally``
+    strands a dataset-sized file that no later run's name ever matches.
+    Swept lazily at the next cache creation for the SAME uri tag and
+    uid: a file whose embedded pid is still alive belongs to a
+    concurrent run and is left alone; removal races and permission
+    errors are ignored (another sweeper may win)."""
+    import glob as _glob
+    import re
+    import tempfile as _tf
+    pat = os.path.join(_tf.gettempdir(),
+                       f"wh_gbdt_{tag}_u{os.getuid()}_p*.binned.cache")
+    for path in _glob.glob(pat):
+        m = re.search(r"_p(\d+)\.", os.path.basename(path))
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue               # owner alive: concurrent run's cache
+        except ProcessLookupError:
+            pass                   # owner dead: stale
+        except OSError:
+            continue               # EPERM etc. — assume alive
+        try:
+            os.remove(path)
+            log.info("swept stale gbdt cache %s (pid %d dead)", path, pid)
+        except OSError:
+            pass
+
 
 class GBDT:
     """Depth-wise hist booster (the xgboost.dmlc capability)."""
@@ -725,6 +771,10 @@ class GBDT:
                 _tf.gettempdir(),
                 f"wh_gbdt_{tag}_u{os.getuid()}_p{os.getpid()}"
                 f".part{part}of{nparts}.binned.cache")
+            # the pid key means a process killed mid-fit leaks its
+            # dataset-sized cache forever (the finally below never ran):
+            # sweep same-uri same-uid leftovers whose owner pid is dead
+            _sweep_stale_caches(tag)
         # pass 1: discover F, collect labels + a bounded sparse sample
         F = num_features
         labels_parts: List[np.ndarray] = []

@@ -111,6 +111,8 @@ class KMeansConfig:
     seed: int = 0
     checkpoint_dir: str = ""
     objv_tol: float = 0.0          # stop when |Δobjv|/n < tol (0 = run max_iter)
+    pipeline_workers: int = 2      # parallel pad+device_put load workers
+                                   # (data/pipeline.py DeviceFeed; 0 = serial)
 
 
 class KMeans:
@@ -137,7 +139,8 @@ class KMeans:
             uri, self.rt, data_format=data_format,
             minibatch_size=self.cfg.minibatch_size,
             num_features=self.cfg.num_features, max_nnz=self.cfg.max_nnz,
-            part=part, nparts=nparts)
+            part=part, nparts=nparts,
+            pipeline_workers=self.cfg.pipeline_workers)
         self.cfg.num_features = loaded.num_features
         self.cfg.max_nnz = loaded.max_nnz
         return loaded.batches

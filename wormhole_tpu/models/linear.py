@@ -147,6 +147,8 @@ class LinearConfig:
     max_nnz: int = 0
     num_features: int = 0
     checkpoint_dir: str = ""
+    pipeline_workers: int = 2  # parallel pad+device_put load workers
+                               # (data/pipeline.py DeviceFeed; 0 = serial)
 
 
 class LinearLBFGS:
@@ -170,7 +172,8 @@ class LinearLBFGS:
             minibatch_size=self.cfg.minibatch_size,
             num_features=self.cfg.num_features, max_nnz=self.cfg.max_nnz,
             feature_multiple=self.rt.model_axis_size,  # even (F,) sharding
-            part=part, nparts=nparts)
+            part=part, nparts=nparts,
+            pipeline_workers=self.cfg.pipeline_workers)
         self.cfg.num_features = loaded.num_features
         self.cfg.max_nnz = loaded.max_nnz
         return loaded.batches

@@ -474,3 +474,50 @@ class WideDeepStore(TableCheckpoint):
                                     self.slots.sharding)
         self.mlp = {k.replace("mlp_", ""): jnp.asarray(v)
                     for k, v in data.items() if k.startswith("mlp_")}
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m wormhole_tpu.models.wide_deep [conf]
+    train_data=<uri> hidden=64,32 [key=val ...]`` — the AsyncSGD driver
+    with a WideDeepStore plugged in; ingest flows through the shared
+    DeviceFeed pipeline.
+
+    ``key=val`` routing mirrors the FM CLI: WideDeepConfig fields go to
+    the model, the rest to the driver Config, with ``num_buckets`` /
+    ``loss`` / ``seed`` mirrored from the driver. ``hidden`` is parsed
+    here (comma-separated ints) because the generic coercer has no
+    Tuple handling."""
+    import dataclasses as _dc
+    import sys
+
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.utils.config import apply_kvs, load_config
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    conf = args.pop(0) if args and "=" not in args[0] else None
+    hidden = None
+    rest = []
+    for a in args:
+        key, _, val = a.partition("=")
+        if key.strip() == "hidden":
+            hidden = tuple(int(p) for p in
+                           val.replace(",", " ").split() if p)
+        else:
+            rest.append(a)
+    shared = {"num_buckets", "loss", "seed"}
+    model_keys = {f.name for f in _dc.fields(WideDeepConfig)} - shared
+    model_kvs = [a for a in rest
+                 if a.partition("=")[0].strip() in model_keys]
+    cfg = load_config(conf, [a for a in rest if a not in model_kvs])
+    mcfg = WideDeepConfig(num_buckets=cfg.num_buckets,
+                          loss=cfg.loss.value, seed=cfg.seed)
+    apply_kvs(mcfg, model_kvs)
+    if hidden is not None:
+        mcfg.hidden = hidden
+    rt = MeshRuntime.create(cfg.mesh_shape)
+    AsyncSGD(cfg, rt, store=WideDeepStore(mcfg, rt)).run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,7 @@ import numpy as np
 
 from wormhole_tpu.parallel.checkpoint import Checkpointer
 from wormhole_tpu.utils.logging import get_logger
+from wormhole_tpu.utils.timer import Timer
 
 log = get_logger("lbfgs")
 
@@ -201,6 +202,11 @@ class LBFGSSolver:
         self.obj = obj
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
         self.history: list = []  # objv per iteration
+        # per-stage profile (grad passes / direction / line search) — the
+        # batch-app counterpart of AsyncSGD's feed-stage timer; the data
+        # passes behind calc_grad stream batches that load_dense_batches
+        # staged through the ingest pipeline (data/pipeline.py)
+        self.timer = Timer()
 
     def _full_objv(self, w: jax.Array) -> jax.Array:
         v = self.obj.objv(w)
@@ -240,7 +246,8 @@ class LBFGSSolver:
             else jnp.zeros(self.obj.num_features, jnp.float32), cfg.memory)
         version, state = self.ckpt.load(template)
 
-        objv, g = self.obj.calc_grad(state.w)
+        with self.timer.scope("grad"):
+            objv, g = self.obj.calc_grad(state.w)
         if cfg.reg_l1:
             objv = objv + cfg.reg_l1 * jnp.sum(jnp.abs(state.w))
         state = LBFGSState(w=state.w, S=state.S, Y=state.Y, nh=state.nh,
@@ -248,8 +255,9 @@ class LBFGSSolver:
 
         for it in range(version, cfg.max_iter):
             pg = pseudo_gradient(state.w, g, cfg.reg_l1)
-            d = compute_direction(state.S, state.Y, state.nh, pg,
-                                  memory=cfg.memory)
+            with self.timer.scope("direction"):
+                d = compute_direction(state.S, state.Y, state.nh, pg,
+                                      memory=cfg.memory)
             d = fix_dir_sign(d, pg, cfg.reg_l1)
             gTd = float(jnp.dot(pg, d))
             if gTd >= 0:  # not a descent direction: restart from steepest
@@ -261,12 +269,14 @@ class LBFGSSolver:
                                    objv=state.objv, version=state.version)
                 d = -pg
                 gTd = float(jnp.dot(pg, d))
-            w_new, f_new, alpha = self._line_search(state, d, pg, gTd)
+            with self.timer.scope("linesearch"):
+                w_new, f_new, alpha = self._line_search(state, d, pg, gTd)
             if w_new is None:
                 log.info("iter %d: line search failed, stopping", it)
                 break
             f_old = float(state.objv)
-            new_objv, g_new = self.obj.calc_grad(w_new)
+            with self.timer.scope("grad"):
+                new_objv, g_new = self.obj.calc_grad(w_new)
             if cfg.reg_l1:
                 new_objv = new_objv + cfg.reg_l1 * jnp.sum(jnp.abs(w_new))
             S, Y, nh = push_history(state.S, state.Y, state.nh,
@@ -285,4 +295,6 @@ class LBFGSSolver:
                 log.info("converged: relative decrease %.3g < %.3g", rel,
                          cfg.epsilon)
                 break
+        if self.timer.totals:
+            log.info("solver profile:\n%s", self.timer.report())
         return state

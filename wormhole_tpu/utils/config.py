@@ -133,6 +133,13 @@ class Config:
     param_dtype: str = "float32"  # slots-table storage dtype ("float32" or
                                   # "bfloat16"; bf16 halves table HBM at
                                   # the cost of accumulator precision)
+    # staged ingest pipeline (data/pipeline.py DeviceFeed): localize+pad
+    # (sparse path) or block read/assembly (crec/text paths) run on
+    # pipeline_workers threads while a transfer thread keeps
+    # pipeline_ring device-resident batches ahead of the compute loop.
+    # 0 = the serial feed path (every stage inline on the consumer).
+    pipeline_workers: int = 2
+    pipeline_ring: int = 2
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1   # save a checkpoint every N data passes

@@ -19,9 +19,13 @@ import numpy as np
 _NF = 10  # float slots
 _NI = 10  # int slots
 
-# slot names, mirroring monitor.h field accessors
-_F_SLOTS = ["objv", "acc", "auc", "objv_w", "wdelta2"]
-_I_SLOTS = ["count", "num_ex", "nnz_w", "nnz_delta", "new_ex"]
+# slot names, mirroring monitor.h field accessors; feed_stall/feed_batches
+# carry the ingest-pipeline counters (data/pipeline.py DeviceFeed): seconds
+# the compute loop waited on the feed ring, and batches it delivered —
+# mergeable across parts/hosts like every other slot
+_F_SLOTS = ["objv", "acc", "auc", "objv_w", "wdelta2", "feed_stall"]
+_I_SLOTS = ["count", "num_ex", "nnz_w", "nnz_delta", "new_ex",
+            "feed_batches"]
 
 
 @dataclass
@@ -56,6 +60,10 @@ class Progress:
     count = property(lambda s: s._iget("count"), lambda s, v: s._iset("count", v))
     num_ex = property(lambda s: s._iget("num_ex"), lambda s, v: s._iset("num_ex", v))
     nnz_w = property(lambda s: s._iget("nnz_w"), lambda s, v: s._iset("nnz_w", v))
+    feed_stall = property(lambda s: s._fget("feed_stall"),
+                          lambda s, v: s._fset("feed_stall", v))
+    feed_batches = property(lambda s: s._iget("feed_batches"),
+                            lambda s, v: s._iset("feed_batches", v))
 
     # --- POD contract ---
     def serialize(self) -> bytes:

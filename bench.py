@@ -28,7 +28,10 @@ completion (the round-1 dispatch-rate artifact; VERDICT r2).
 ``--phases a,b,c`` runs a subset; ``--budget SECONDS`` (default 840)
 skips phases not yet started when the budget expires — either way the
 summary JSON always prints, instead of a harness timeout killing the
-whole run with nothing parseable on stdout (the round-5 rc=124). The
+whole run with nothing parseable on stdout (the round-5 rc=124).
+``--out FILE`` (default bench_summary.json) additionally rewrites the
+summary ATOMICALLY after every finished phase, so even a hard kill
+(SIGKILL, OOM) mid-phase leaves every already-measured number on disk. The
 e2e_stream / e2e_text phases time the same pass serial
 (pipeline_workers=0) and pipelined and report the speedup plus the
 feed's stall counters.
@@ -623,17 +626,23 @@ def bench_lbfgs() -> dict:
 
 
 def bench_gbdt() -> dict:
-    """GBDT rounds/sec at the Higgs-1M shape (BASELINE.json's
-    learn/xgboost config: dense 1M x 28, depth 6, 256 bins) — in-memory
-    AND external-memory (streamed BinnedCache) variants."""
+    """GBDT rounds/sec at a fixed Higgs-shaped slice (dense 200K x 28,
+    depth 6, 256 bins — the BASELINE.json learn/xgboost config shrunk
+    5x) — in-memory AND external-memory (streamed BinnedCache through
+    data/pipeline.DeviceFeed) variants. Right-sized per PR 2: the fixed
+    200K row count and 1<<16 chunk rows (4 chunks: 3 full + ragged tail)
+    keep the phase a couple of minutes while still exercising
+    multi-chunk streaming, and per-round ROW rates are reported so the
+    in-memory vs external comparison survives workload resizing."""
     from wormhole_tpu.models.gbdt import (BinnedCache, GBDT, GBDTConfig,
-                                          apply_bins, quantile_bins)
+                                          quantile_bins)
+    from wormhole_tpu.ops import histmm
     rng = np.random.default_rng(2)
-    n, F, depth = 1_000_000, 28, 6
+    n, F, depth, chunk_rows = 200_000, 28, 6, 1 << 16
     x = rng.standard_normal((n, F)).astype(np.float32)
     y = ((x[:, 0] + 0.5 * x[:, 3] + 0.3 * rng.standard_normal(n)) > 0
          ).astype(np.float32)
-    warm_rounds, rounds = 1, 4
+    warm_rounds, rounds = 1, 3
     m1 = GBDT(GBDTConfig(num_round=warm_rounds, max_depth=depth))
     m1.fit(x, y)                      # compile all level shapes
     m2 = GBDT(GBDTConfig(num_round=rounds, max_depth=depth))
@@ -647,9 +656,9 @@ def bench_gbdt() -> dict:
     cache_path = os.path.join(tempfile.mkdtemp(prefix="wh_bench_gbdt_"),
                               "higgs.cache")
     t0 = time.perf_counter()
-    cache = BinnedCache.create(cache_path, F, 1 << 17)
-    for lo in range(0, n, 1 << 17):
-        cache.append(bins[lo:lo + (1 << 17)])
+    cache = BinnedCache.create(cache_path, F, chunk_rows)
+    for lo in range(0, n, chunk_rows):
+        cache.append(bins[lo:lo + chunk_rows])
     cache.close()
     cache_build_s = time.perf_counter() - t0
     cache = BinnedCache.open(cache_path)
@@ -671,7 +680,23 @@ def bench_gbdt() -> dict:
     return {"round_sec_in_memory": in_mem, "rounds_per_sec": 1.0 / in_mem,
             "round_sec_external": ext,
             "rounds_per_sec_external": 1.0 / ext,
-            "cache_build_sec": cache_build_s, "shape": [n, F, depth]}
+            # per-round row rates: directly comparable across workload
+            # sizes and between the two variants
+            "rows_per_sec_in_memory": n / in_mem,
+            "rows_per_sec_external": n / ext,
+            "external_over_in_memory": ext / in_mem,
+            "hist_kernel": histmm.resolve_kernel(
+                m3.cfg.gbdt_hist_kernel, num_feat=F,
+                num_bins=m3.cfg.num_bins),
+            # counters from the PR-2 instrumentation: level-hist kernel
+            # seconds and chunk-feed consumer stalls, per timed round
+            "hist_sec_per_round_in_memory": m2.progress.gbdt_hist / rounds,
+            "hist_sec_per_round_external": m3.progress.gbdt_hist / rounds,
+            "chunk_stall_sec_per_round":
+                m3.progress.gbdt_chunk_stall / rounds,
+            "cache_build_sec": cache_build_s,
+            "num_chunks": cache.num_chunks, "chunk_rows": chunk_rows,
+            "shape": [n, F, depth]}
 
 
 def bench_scale_curve(workdir: str, rng) -> list:
@@ -750,6 +775,102 @@ _CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
 _DEFAULT_BUDGET = 840.0  # under the 15-min harness timeout, with margin
 
 
+def _summarize(results: dict, failed: dict, skipped: list, pending: list,
+               kind: str, peak_hbm, peak_mxu, budget: float,
+               elapsed: float) -> dict:
+    """Build the summary JSON object from whatever phases have finished
+    so far. Called after EVERY phase (not just at exit) so the --out
+    file always holds the latest complete snapshot."""
+    e2e = results.get("e2e_crec2")
+    tile = results.get("device_tile")
+    value = e2e["ex_per_sec"] if e2e else None
+    extra = {
+        "device_kind": kind,
+        "host_cores": os.cpu_count(),
+        "phases_run": sorted(results),
+        "phases_failed": failed,
+        "phases_skipped_budget": skipped,
+        "phases_pending": pending,
+        "budget_sec": budget,
+        "elapsed_sec": round(elapsed, 1),
+    }
+    if e2e:
+        extra["e2e_steady_cached"] = {
+            k: (round(v, 1) if isinstance(v, float)
+                and "dispersion" not in k else v)
+            for k, v in e2e.items()}
+        extra["e2e_cold_stream_ex_per_sec"] = round(
+            e2e["cold_ex_per_sec"], 1)
+    if tile:
+        if value:
+            extra["vs_device_step"] = round(value / tile["ex_per_sec"], 3)
+        extra.update({
+            "device_step_tile_examples_per_sec": round(
+                tile["ex_per_sec"], 1),
+            "tile_step_ms": round(tile["step_ms"], 2),
+            "tile_block_rows": tile["block_rows"],
+            "mxu_tflops": round(tile["mxu_tflops"], 1),
+            "mxu_frac": (round(tile["mxu_tflops"] / peak_mxu, 3)
+                         if peak_mxu else None),
+            "hbm_gbps": round(tile["hbm_gbps"], 1),
+            "hbm_peak_gbps": peak_hbm,
+        })
+    if "device_sparse" in results:
+        extra["device_step_sparse_examples_per_sec"] = round(
+            results["device_sparse"], 1)
+    if "device_dense_apply" in results:
+        extra["device_step_dense_apply_examples_per_sec"] = round(
+            results["device_dense_apply"], 1)
+    if "device_fm" in results:
+        extra["device_step_fm_examples_per_sec"] = round(
+            results["device_fm"], 1)
+    if "device_wide_deep" in results:
+        extra["device_step_wide_deep_examples_per_sec"] = round(
+            results["device_wide_deep"], 1)
+    if "channel_ratios" in results:
+        extra["channel_step_ratios_same_window"] = \
+            results["channel_ratios"]
+    if "scale_curve" in results:
+        extra["scale_curve_tile_step"] = results["scale_curve"]
+    for name, key in (("kmeans", "kmeans_mnist784"),
+                      ("lbfgs", "lbfgs_rcv1"),
+                      ("gbdt", "gbdt_higgs200k")):
+        if name in results:
+            extra[key] = {k: (round(v, 4) if isinstance(v, float) else v)
+                          for k, v in results[name].items()}
+    if "e2e_stream" in results:
+        stream = results["e2e_stream"]
+        extra["e2e_stream_noncached"] = {
+            k: (round(v, 1) if isinstance(v, float)
+                and not k.endswith("speedup") else v)
+            for k, v in stream.items()}
+    if "e2e_text" in results:
+        text = results["e2e_text"]
+        extra["criteo_text"] = {
+            k: (round(v, 1) if isinstance(v, float)
+                and not k.endswith("speedup") else v)
+            for k, v in text.items()}
+    return {
+        "metric": "end_to_end_examples_per_sec",
+        "value": round(value, 1) if value is not None else None,
+        "unit": "examples/sec",
+        "vs_baseline": (round(value / BASELINE_EX_PER_SEC, 4)
+                        if value is not None else None),
+        "extra": extra,
+    }
+
+
+def _write_summary(path: str, summary: dict) -> None:
+    """Atomic rewrite (tmp file in the same dir + os.replace): readers
+    never see a torn file, and a run killed mid-phase leaves the last
+    complete snapshot on disk instead of nothing."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def main(argv=None) -> None:
     import argparse
     import sys
@@ -763,6 +884,12 @@ def main(argv=None) -> None:
                     help="wall-clock budget (sec): phases not yet started "
                          "when it expires are skipped and the summary "
                          "still prints (<=0 disables)")
+    ap.add_argument("--out", default="bench_summary.json",
+                    help="summary JSON file, atomically rewritten after "
+                         "EVERY phase so a killed run still leaves the "
+                         "already-measured numbers on disk (empty "
+                         "string disables the file; stdout always gets "
+                         "the final one-line JSON)")
     args = ap.parse_args(argv)
     sel = [p.strip() for p in args.phases.split(",") if p.strip()] \
         if args.phases else list(PHASES)
@@ -816,6 +943,21 @@ def main(argv=None) -> None:
     failed: dict = {}
     bench_t0 = time.perf_counter()
     todo = [p for p in PHASES if p in sel]
+
+    def checkpoint(pending: list) -> None:
+        # incremental summary after every phase: a driver timeout that
+        # kills the process mid-run can no longer erase measured numbers
+        if not args.out:
+            return
+        summary = _summarize(results, failed, skipped, pending, kind,
+                             peak_hbm, peak_mxu, args.budget,
+                             time.perf_counter() - bench_t0)
+        try:
+            _write_summary(args.out, summary)
+        except OSError as e:
+            print(f"[bench] cannot write {args.out}: {e}",
+                  file=sys.stderr, flush=True)
+
     for i, name in enumerate(todo):
         if args.budget > 0 and \
                 time.perf_counter() - bench_t0 > args.budget:
@@ -835,6 +977,7 @@ def main(argv=None) -> None:
             print(f"[bench] {name} done in "
                   f"{time.perf_counter() - t0:.0f}s",
                   file=sys.stderr, flush=True)
+        checkpoint(todo[i + 1:])
         if stores_box and not any(p in _STORE_PHASES
                                   for p in todo[i + 1:]):
             stores_box.clear()   # free the HBM tables for later phases
@@ -845,83 +988,16 @@ def main(argv=None) -> None:
         except OSError:
             pass
 
-    e2e = results.get("e2e_crec2")
-    tile = results.get("device_tile")
-    value = e2e["ex_per_sec"] if e2e else None
-    extra = {
-        "device_kind": kind,
-        "host_cores": os.cpu_count(),
-        "phases_run": sorted(results),
-        "phases_failed": failed,
-        "phases_skipped_budget": skipped,
-        "budget_sec": args.budget,
-        "elapsed_sec": round(time.perf_counter() - bench_t0, 1),
-    }
-    if e2e:
-        extra["e2e_steady_cached"] = {
-            k: (round(v, 1) if isinstance(v, float)
-                and "dispersion" not in k else v)
-            for k, v in e2e.items()}
-        extra["e2e_cold_stream_ex_per_sec"] = round(
-            e2e["cold_ex_per_sec"], 1)
-    if tile:
-        if value:
-            extra["vs_device_step"] = round(value / tile["ex_per_sec"], 3)
-        extra.update({
-            "device_step_tile_examples_per_sec": round(
-                tile["ex_per_sec"], 1),
-            "tile_step_ms": round(tile["step_ms"], 2),
-            "tile_block_rows": tile["block_rows"],
-            "mxu_tflops": round(tile["mxu_tflops"], 1),
-            "mxu_frac": (round(tile["mxu_tflops"] / peak_mxu, 3)
-                         if peak_mxu else None),
-            "hbm_gbps": round(tile["hbm_gbps"], 1),
-            "hbm_peak_gbps": peak_hbm,
-        })
-    if "device_sparse" in results:
-        extra["device_step_sparse_examples_per_sec"] = round(
-            results["device_sparse"], 1)
-    if "device_dense_apply" in results:
-        extra["device_step_dense_apply_examples_per_sec"] = round(
-            results["device_dense_apply"], 1)
-    if "device_fm" in results:
-        extra["device_step_fm_examples_per_sec"] = round(
-            results["device_fm"], 1)
-    if "device_wide_deep" in results:
-        extra["device_step_wide_deep_examples_per_sec"] = round(
-            results["device_wide_deep"], 1)
-    if "channel_ratios" in results:
-        extra["channel_step_ratios_same_window"] = \
-            results["channel_ratios"]
-    if "scale_curve" in results:
-        extra["scale_curve_tile_step"] = results["scale_curve"]
-    for name, key in (("kmeans", "kmeans_mnist784"),
-                      ("lbfgs", "lbfgs_rcv1"),
-                      ("gbdt", "gbdt_higgs1m")):
-        if name in results:
-            extra[key] = {k: (round(v, 4) if isinstance(v, float) else v)
-                          for k, v in results[name].items()}
-    if "e2e_stream" in results:
-        stream = results["e2e_stream"]
-        extra["e2e_stream_noncached"] = {
-            k: (round(v, 1) if isinstance(v, float)
-                and not k.endswith("speedup") else v)
-            for k, v in stream.items()}
-    if "e2e_text" in results:
-        text = results["e2e_text"]
-        extra["criteo_text"] = {
-            k: (round(v, 1) if isinstance(v, float)
-                and not k.endswith("speedup") else v)
-            for k, v in text.items()}
-
-    print(json.dumps({
-        "metric": "end_to_end_examples_per_sec",
-        "value": round(value, 1) if value is not None else None,
-        "unit": "examples/sec",
-        "vs_baseline": (round(value / BASELINE_EX_PER_SEC, 4)
-                        if value is not None else None),
-        "extra": extra,
-    }))
+    summary = _summarize(results, failed, skipped, [], kind, peak_hbm,
+                         peak_mxu, args.budget,
+                         time.perf_counter() - bench_t0)
+    if args.out:
+        try:
+            _write_summary(args.out, summary)
+        except OSError as e:
+            print(f"[bench] cannot write {args.out}: {e}",
+                  file=sys.stderr, flush=True)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

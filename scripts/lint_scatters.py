@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Lint: no serialized scatter-adds (``.at[...].add``) outside the allowlist.
+
+XLA:TPU lowers ``x.at[idx].add(v)`` to a serialized per-element update
+loop (~13-25ns/element), which is exactly the pathology ops/tilemm.py and
+ops/histmm.py exist to avoid: both reformulate the scatter as a one-hot
+matmul on the MXU. This lint keeps the win from regressing — a new
+``.at[...].add`` in an unaudited file fails the build until it is either
+rewritten as a matmul or consciously added below with a reason.
+
+The check is textual (comments stripped, bracket contents may span
+lines), not an AST walk: it must catch the pattern inside strings being
+exec'd or built up for pallas too, and false positives are resolved by
+the allowlist anyway.
+
+Run from the repo root (or pass ``--root``)::
+
+    python scripts/lint_scatters.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+# Audited files that legitimately keep `.at[...].add` sites. Every entry
+# carries the reason the scatter is acceptable there. models/gbdt.py is
+# deliberately ABSENT: its level-histogram scatters moved to ops/histmm
+# (PR 2) and must not come back.
+ALLOWLIST = {
+    "wormhole_tpu/ops/spmv.py":
+        "documented scatter fallback for the y = A^T x product; the "
+        "matmul path is the default, this is the oracle",
+    "wormhole_tpu/ops/tilemm.py":
+        "COO overflow-bucket spill: O(overflow) elements, not O(nnz); "
+        "the hot tile path is already a one-hot matmul",
+    "wormhole_tpu/ops/histmm.py":
+        "the scatter ORACLE kernels (_dense_scatter/_sparse_scatter) "
+        "that the matmul kernels are parity-tested against",
+    "wormhole_tpu/learners/store.py":
+        "v1 store uniq-key push + overflow spill: O(unique keys) / "
+        "O(overflow) elements per step, off the crec2 hot path",
+    "wormhole_tpu/solver/lbfgs.py":
+        "two-loop recursion history update: O(lbfgs_memory) ~ 10 "
+        "elements, nothing to vectorize",
+    "wormhole_tpu/models/kmeans.py":
+        "per-cluster count/weight stats: O(clusters) cells, dominated "
+        "by the distance matmul",
+    "wormhole_tpu/models/fm.py":
+        "uniq-key push + overflow spill (same shape as store.py)",
+    "wormhole_tpu/models/wide_deep.py":
+        "uniq-key push + overflow spill (same shape as store.py)",
+}
+
+# `.at[` ... `].add(` with the subscript allowed to span lines; targets
+# only scatter-ADD — `.at[].set/.max/.min/.mul` have different lowering
+# and are not what tilemm/histmm replace.
+_PAT = re.compile(r"\.at\s*\[[^\]]*\]\s*\.add\s*\(", re.S)
+
+
+def _strip_comments(text: str) -> str:
+    """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive about
+    `#` inside string literals — good enough for a lint whose false
+    positives land in a human-reviewed allowlist."""
+    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
+
+
+def scan_file(path: str) -> list:
+    """Return 1-based line numbers of scatter-add sites in ``path``."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        text = _strip_comments(f.read())
+    return [text.count("\n", 0, m.start()) + 1
+            for m in _PAT.finditer(text)]
+
+
+def run(root: str) -> int:
+    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
+    pkg = os.path.join(root, "wormhole_tpu")
+    if not os.path.isdir(pkg):
+        print(f"lint_scatters: no wormhole_tpu package under {root!r}",
+              file=sys.stderr)
+        return 2
+    violations = []
+    seen_allowed = set()
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            lines = scan_file(path)
+            if not lines:
+                continue
+            if rel in ALLOWLIST:
+                seen_allowed.add(rel)
+            else:
+                violations.extend(f"{rel}:{ln}" for ln in lines)
+    for rel in sorted(set(ALLOWLIST) - seen_allowed):
+        # stale entries are a warning, not a failure: deleting the last
+        # scatter from an audited file should not break the build
+        print(f"lint_scatters: allowlist entry {rel} has no "
+              f"scatter-adds (stale?)", file=sys.stderr)
+    if violations:
+        print("lint_scatters: serialized scatter-add (`.at[...].add`) "
+              "outside the allowlist:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        print("either reformulate as a one-hot matmul (see ops/histmm.py"
+              " / ops/tilemm.py) or add the file to ALLOWLIST in "
+              "scripts/lint_scatters.py with a reason", file=sys.stderr)
+        return 1
+    print(f"lint_scatters: OK ({len(seen_allowed)} allowlisted files)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=".",
+                    help="repo root containing wormhole_tpu/ "
+                         "(default: cwd)")
+    args = ap.parse_args(argv)
+    return run(args.root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -273,3 +273,106 @@ def test_gbdt_external_checkpoint_resume(tmp_path):
                                       np.asarray(tb.feature))
         np.testing.assert_allclose(np.asarray(tf.weight),
                                    np.asarray(tb.weight), atol=1e-4)
+
+
+# -- ops/histmm kernel modes + pipelined chunk feed (PR 2) -------------------
+
+def _assert_same_trees(a, b, w_atol=1e-4):
+    assert len(a.trees) == len(b.trees)
+    for ta, tb in zip(a.trees, b.trees):
+        np.testing.assert_array_equal(np.asarray(ta.feature),
+                                      np.asarray(tb.feature))
+        np.testing.assert_array_equal(np.asarray(ta.split_bin),
+                                      np.asarray(tb.split_bin))
+        np.testing.assert_array_equal(np.asarray(ta.is_leaf),
+                                      np.asarray(tb.is_leaf))
+        np.testing.assert_allclose(np.asarray(ta.weight),
+                                   np.asarray(tb.weight), atol=w_atol)
+
+
+def test_hist_kernel_modes_build_identical_trees(rng):
+    """The MXU one-hot matmul histograms (ops/histmm) and the scatter
+    oracle pick the same splits, leaf weights, and per-round logloss —
+    whole-model parity across gbdt_hist_kernel modes, dense path."""
+    x, y = xor_data(rng)
+    models = {}
+    for k in ("scatter", "matmul", "auto"):
+        m = GBDT(GBDTConfig(num_round=4, max_depth=3, eta=0.5,
+                            gbdt_hist_kernel=k))
+        m.fit(x, y)
+        models[k] = m
+    _assert_same_trees(models["scatter"], models["matmul"])
+    _assert_same_trees(models["scatter"], models["auto"])
+    np.testing.assert_allclose(models["scatter"].history,
+                               models["matmul"].history, rtol=1e-5)
+    # the hist-kernel counter accumulated into the per-pass progress slot
+    assert models["matmul"].progress.gbdt_hist > 0.0
+
+
+def test_hist_kernel_modes_sparse_identical_trees():
+    """Kernel-mode parity on the CSR-entry path (hists + per-node totals
+    both go through ops/histmm)."""
+    from wormhole_tpu.models.gbdt import SparseBins
+    rng = np.random.default_rng(23)
+    n, F = 400, 6
+    x = rng.standard_normal((n, F)).astype(np.float32)
+    y = (x[:, 1] - 0.5 * x[:, 4] > 0).astype(np.float32)
+    bins, cuts = quantile_bins(x, 64)
+    er = np.repeat(np.arange(n), F)
+    ef = np.tile(np.arange(F), n)
+    eb = bins.reshape(-1).astype(np.int32)
+    models = {}
+    for k in ("scatter", "matmul"):
+        m = GBDT(GBDTConfig(num_round=4, max_depth=3, num_bins=64,
+                            gbdt_hist_kernel=k))
+        m.fit_sparse(SparseBins(er, ef, eb, y, cuts, np.arange(F)))
+        models[k] = m
+    _assert_same_trees(models["scatter"], models["matmul"], w_atol=1e-5)
+    np.testing.assert_allclose(models["scatter"].history,
+                               models["matmul"].history, rtol=1e-5)
+
+
+def test_external_kernel_modes_and_pipeline_parity(tmp_path):
+    """External-memory training is invariant to BOTH the histogram
+    kernel mode and the chunk-feed pipelining (workers=0 serial oracle
+    vs threaded DeviceFeed): identical trees and logloss history."""
+    from wormhole_tpu.models.gbdt import load_dense
+    rng = np.random.default_rng(31)
+    n, F = 2000, 8
+    x = np.round(rng.standard_normal((n, F)), 3).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 2] > 0)).astype(np.float32)
+    path = tmp_path / "train.libsvm"
+    _write_libsvm(path, x, y)
+    variants = {}
+    for name, kernel, workers in (("serial_scatter", "scatter", 0),
+                                  ("piped_scatter", "scatter", 2),
+                                  ("piped_matmul", "matmul", 2)):
+        m = GBDT(GBDTConfig(num_round=3, max_depth=3, eta=0.5,
+                            gbdt_hist_kernel=kernel,
+                            pipeline_workers=workers))
+        m.fit_external(str(path), "libsvm", chunk_rows=256,
+                       cache_path=str(tmp_path / f"{name}.cache"))
+        variants[name] = m
+    _assert_same_trees(variants["serial_scatter"],
+                       variants["piped_scatter"])
+    _assert_same_trees(variants["serial_scatter"],
+                       variants["piped_matmul"])
+    np.testing.assert_allclose(variants["serial_scatter"].history,
+                               variants["piped_matmul"].history,
+                               rtol=1e-5)
+    # chunk-feed counters drained into the progress slots + timer
+    piped = variants["piped_scatter"]
+    assert piped.progress.feed_batches > 0
+    assert piped.progress.gbdt_hist > 0.0
+    assert "gbdt_chunk_feed_stall" in piped.timer.totals
+    # in-memory fit on the same data builds the same trees as external
+    xd, yd = load_dense(str(path), "libsvm")
+    mem = GBDT(GBDTConfig(num_round=3, max_depth=3, eta=0.5,
+                          gbdt_hist_kernel="matmul"))
+    mem.fit(xd, yd)
+    _assert_same_trees(mem, variants["piped_matmul"])
+
+
+def test_gbdt_rejects_unknown_hist_kernel():
+    with pytest.raises(ValueError):
+        GBDT(GBDTConfig(gbdt_hist_kernel="mxu"))

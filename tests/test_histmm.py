@@ -1,0 +1,118 @@
+"""Parity tests for the one-hot matmul histogram kernels (ops/histmm):
+matmul == scatter oracle within fp32 summation-order tolerance, across
+masks, node widths, non-tile-multiple row counts, and sparse padding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wormhole_tpu.ops import histmm
+
+
+def _dense_case(rng, n, F, num_nodes, num_bins):
+    bins = rng.integers(0, num_bins, size=(n, F)).astype(np.uint8)
+    node = rng.integers(0, num_nodes, size=n).astype(np.int32)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    mask = (rng.uniform(size=n) < 0.8).astype(np.float32)
+    return (jnp.asarray(bins), jnp.asarray(node), jnp.asarray(grad),
+            jnp.asarray(hess), jnp.asarray(mask))
+
+
+@pytest.mark.parametrize("n,F,num_nodes,num_bins", [
+    (400, 3, 1, 16),        # root level, row count far below one tile
+    (1000, 7, 8, 32),       # mid level, ragged vs the 8-row padding
+    (4096 + 37, 5, 64, 64),  # deepest level, crosses a tile boundary
+])
+def test_dense_matmul_matches_scatter(rng, n, F, num_nodes, num_bins):
+    args = _dense_case(rng, n, F, num_nodes, num_bins)
+    gh_m, hh_m = histmm.level_hists(
+        *args, num_nodes=num_nodes, num_bins=num_bins, kernel="matmul")
+    gh_s, hh_s = histmm.level_hists(
+        *args, num_nodes=num_nodes, num_bins=num_bins, kernel="scatter")
+    np.testing.assert_allclose(np.asarray(gh_m), np.asarray(gh_s),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hh_m), np.asarray(hh_s),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_matmul_totals_conserved(rng):
+    """Every row's (grad, hess) lands in exactly one (node, bin) cell per
+    feature — column sums must equal the masked grad/hess totals."""
+    n, F, num_nodes, num_bins = 777, 4, 8, 16
+    args = _dense_case(rng, n, F, num_nodes, num_bins)
+    gh, hh = histmm.level_hists(
+        *args, num_nodes=num_nodes, num_bins=num_bins, kernel="matmul")
+    gm = np.asarray(args[2]) * np.asarray(args[4])
+    hm = np.asarray(args[3]) * np.asarray(args[4])
+    np.testing.assert_allclose(np.asarray(gh).sum(axis=(0, 2)),
+                               np.full(F, gm.sum()), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(hh).sum(axis=(0, 2)),
+                               np.full(F, hm.sum()), rtol=1e-4)
+
+
+def _sparse_case(rng, n, E, num_feat, num_nodes, num_bins, pad=0):
+    er = rng.integers(0, n, size=E).astype(np.int32)
+    ef = rng.integers(0, num_feat, size=E).astype(np.int32)
+    eb = rng.integers(0, num_bins, size=E).astype(np.int32)
+    if pad:   # trailing padding entries: ef == -1 must contribute nothing
+        ef[-pad:] = -1
+    node = rng.integers(0, num_nodes, size=n).astype(np.int32)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    mask = (rng.uniform(size=n) < 0.8).astype(np.float32)
+    return tuple(jnp.asarray(a) for a in
+                 (er, ef, eb, node, grad, hess, mask))
+
+
+@pytest.mark.parametrize("pad", [0, 57])
+def test_sparse_matmul_matches_scatter(rng, pad):
+    n, E, num_feat, num_nodes, num_bins = 500, 3000, 11, 4, 16
+    args = _sparse_case(rng, n, E, num_feat, num_nodes, num_bins, pad)
+    out_m = histmm.level_hists_sparse(
+        *args, num_nodes=num_nodes, num_bins=num_bins, num_feat=num_feat,
+        kernel="matmul")
+    out_s = histmm.level_hists_sparse(
+        *args, num_nodes=num_nodes, num_bins=num_bins, num_feat=num_feat,
+        kernel="scatter")
+    for a_m, a_s in zip(out_m, out_s):
+        np.testing.assert_allclose(np.asarray(a_m), np.asarray(a_s),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_node_totals_matches_masked_sums(rng):
+    n, num_nodes = 1234, 16
+    node = rng.integers(0, num_nodes, size=n).astype(np.int32)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    mask = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    gt, ht = histmm.node_totals(
+        jnp.asarray(node), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), num_nodes=num_nodes)
+    gt_ref = np.zeros(num_nodes, np.float64)
+    ht_ref = np.zeros(num_nodes, np.float64)
+    np.add.at(gt_ref, node, grad * mask)
+    np.add.at(ht_ref, node, hess * mask)
+    np.testing.assert_allclose(np.asarray(gt), gt_ref, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ht), ht_ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_resolve_kernel():
+    # explicit modes pass through, unknown names are rejected
+    assert histmm.resolve_kernel("matmul", num_feat=8, num_bins=16) \
+        == "matmul"
+    assert histmm.resolve_kernel("scatter", num_feat=8, num_bins=16) \
+        == "scatter"
+    with pytest.raises(ValueError):
+        histmm.resolve_kernel("mxu", num_feat=8, num_bins=16)
+    # auto resolves from backend + static shape only
+    auto = histmm.resolve_kernel("auto", num_feat=8, num_bins=16)
+    if jax.default_backend() == "cpu":
+        assert auto == "scatter"
+    else:
+        assert auto == "matmul"
+        assert histmm.resolve_kernel(
+            "auto", num_feat=1 << 20, num_bins=256) == "scatter"

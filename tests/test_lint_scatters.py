@@ -1,0 +1,61 @@
+"""The scatter lint (scripts/lint_scatters.py) guards the PR-2 win: GBDT
+level histograms moved from `.at[...].add` scatters to one-hot matmuls
+(ops/histmm), so models/gbdt.py must stay OFF the allowlist and any new
+serialized scatter-add outside the audited files must fail the build."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "lint_scatters.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True)
+
+
+def test_repo_passes_lint():
+    r = _run("--root", REPO)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_gbdt_not_allowlisted():
+    # the point of PR 2: the GBDT histogram scatters are gone for good
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_scatters
+    finally:
+        sys.path.pop(0)
+    assert "wormhole_tpu/models/gbdt.py" not in lint_scatters.ALLOWLIST
+    # and the file really has no scatter-adds to sneak back in
+    assert lint_scatters.scan_file(
+        os.path.join(REPO, "wormhole_tpu", "models", "gbdt.py")) == []
+
+
+def test_synthetic_violation_caught(tmp_path):
+    pkg = tmp_path / "wormhole_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x, i, v):\n"
+        "    # comment mention of .at[].add( must NOT trip the lint\n"
+        "    return x.at[\n"
+        "        i\n"
+        "    ].add(v)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    # file:line of the multiline scatter, pointing at the `.at[` line
+    assert "wormhole_tpu/bad.py:4" in r.stderr
+
+
+def test_allowed_ops_do_not_trip(tmp_path):
+    pkg = tmp_path / "wormhole_tpu"
+    pkg.mkdir()
+    (pkg / "fine.py").write_text(
+        "def f(x, i, v):\n"
+        "    return x.at[i].set(v), x.at[i].max(v), x.at[i].mul(v)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0

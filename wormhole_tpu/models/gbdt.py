@@ -42,10 +42,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from wormhole_tpu.ops import histmm
 from wormhole_tpu.ops.metrics import accuracy, auc, logloss
 from wormhole_tpu.parallel.checkpoint import Checkpointer
 from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 from wormhole_tpu.utils.logging import get_logger
+from wormhole_tpu.utils.progress import Progress
+from wormhole_tpu.utils.timer import Timer
 
 log = get_logger("gbdt")
 
@@ -64,6 +67,15 @@ class GBDTConfig:
     checkpoint_dir: str = ""
     msg_compression: bool = False  # zlib the per-level histogram allreduce
                                    # payloads (ps-lite COMPRESSING filter)
+    # level-histogram kernel (ops/histmm): "matmul" = one-hot matmuls on
+    # the MXU, "scatter" = the .at[].add oracle, "auto" = per backend and
+    # (feature x bin) width — identical resolution on every host
+    gbdt_hist_kernel: str = "auto"
+    # external-memory chunk feed (data/pipeline.py DeviceFeed): workers
+    # pread cache chunks while the device histograms the current one;
+    # 0 = serial (every stage inline on the consumer)
+    pipeline_workers: int = 2
+    pipeline_ring: int = 2
 
 
 @jax.tree_util.register_dataclass
@@ -90,31 +102,37 @@ def _grad_hess(margin: jax.Array, labels: jax.Array, objective: str):
     raise ValueError(f"unknown objective {objective!r}")
 
 
-@partial(jax.jit, static_argnames=("num_nodes", "num_bins"))
-def _level_hists(bins: jax.Array, node: jax.Array, grad: jax.Array,
-                 hess: jax.Array, row_mask: jax.Array, *,
-                 num_nodes: int, num_bins: int):
-    """LOCAL (node, feature, bin) grad/hess histograms for one level.
+# the level-histogram kernels live in ops/histmm (one-hot matmuls on the
+# MXU with the original scatter-add bodies kept there as oracle/fallback);
+# the historical names stay for callers and tests
+_level_hists = histmm.level_hists
+_level_hists_sparse = histmm.level_hists_sparse
 
-    bins (n, F) uint8; node (n,) int32 LOCAL node id of each row within
-    this level; row_mask (n,) 0 for rows already parked on a leaf (or data
-    padding). In a multi-process run each host histograms its own row
-    shard and the results are allreduced — the reference's per-level
-    gradient-histogram allreduce (xgboost/README.md:27-33, dsplit=row).
-    """
-    n, F = bins.shape
-    f_idx = jnp.arange(F, dtype=jnp.int32)[None, :]
-    flat = (node[:, None] * (F * num_bins) + f_idx * num_bins
-            + bins.astype(jnp.int32)).reshape(-1)
-    gm = (grad * row_mask)[:, None]
-    hm = (hess * row_mask)[:, None]
-    ghist = jnp.zeros(num_nodes * F * num_bins, jnp.float32).at[flat].add(
-        jnp.broadcast_to(gm, (n, F)).reshape(-1)
-    ).reshape(num_nodes, F, num_bins)
-    hhist = jnp.zeros(num_nodes * F * num_bins, jnp.float32).at[flat].add(
-        jnp.broadcast_to(hm, (n, F)).reshape(-1)
-    ).reshape(num_nodes, F, num_bins)
-    return ghist, hhist
+
+def _sibling_hists(left_g: np.ndarray, left_h: np.ndarray,
+                   parent_g: np.ndarray, parent_h: np.ndarray,
+                   active: np.ndarray):
+    """Reconstruct a full level from left-child histograms (LightGBM's
+    parent − sibling subtraction): even slots are the measured left
+    children, odd slots are parent − left in f64 from the previous
+    level's reconstructed GLOBAL hists. Runs on every host after the
+    allreduce from identical inputs, so the levels stay bit-identical
+    across ranks. Children of non-split parents are zeroed — their
+    ``active`` bit is off, but zero mass keeps every kernel mode feeding
+    the same arrays to split selection. Works for (nodes, F, B) hists
+    and (nodes,) totals alike."""
+    level_nodes = 2 * left_g.shape[0]
+    gh = np.zeros((level_nodes,) + left_g.shape[1:], np.float64)
+    hh = np.zeros_like(gh)
+    lg = left_g.astype(np.float64)
+    lh = left_h.astype(np.float64)
+    gh[0::2] = lg
+    hh[0::2] = lh
+    gh[1::2] = parent_g - lg
+    hh[1::2] = parent_h - lh
+    gh[~active] = 0.0
+    hh[~active] = 0.0
+    return gh, hh
 
 
 def _best_splits(ghist: np.ndarray, hhist: np.ndarray, active: np.ndarray,
@@ -178,31 +196,9 @@ def _predict_trees(feature: jax.Array, split_bin: jax.Array,
 # over PRESENT values only; rows missing a split feature route by the
 # node's learned default direction (xgboost's sparsity-aware split,
 # which the reference consumes via external-memory '#dtrain.cache',
-# xgboost/README.md:47-55). Histograms are scatter-adds over entries —
-# E = nnz instead of n*F work and memory.
+# xgboost/README.md:47-55). Histograms accumulate over entries — E = nnz
+# instead of n*F work and memory (ops/histmm, matmul or scatter kernel).
 # ---------------------------------------------------------------------------
-
-@partial(jax.jit, static_argnames=("num_nodes", "num_bins", "num_feat"))
-def _level_hists_sparse(er: jax.Array, ef: jax.Array, eb: jax.Array,
-                        node: jax.Array, grad: jax.Array, hess: jax.Array,
-                        row_mask: jax.Array, *, num_nodes: int,
-                        num_bins: int, num_feat: int):
-    """LOCAL histograms over CSR entries, plus per-node grad/hess totals
-    (needed to price the missing mass). Padding entries carry ef == -1."""
-    valid = (ef >= 0).astype(jnp.float32)
-    gm = grad * row_mask
-    hm = hess * row_mask
-    flat = (node[er] * (num_feat * num_bins) + jnp.maximum(ef, 0) * num_bins
-            + eb)
-    flat = jnp.where(ef >= 0, flat, 0)
-    ghist = jnp.zeros(num_nodes * num_feat * num_bins, jnp.float32).at[
-        flat].add(gm[er] * valid).reshape(num_nodes, num_feat, num_bins)
-    hhist = jnp.zeros(num_nodes * num_feat * num_bins, jnp.float32).at[
-        flat].add(hm[er] * valid).reshape(num_nodes, num_feat, num_bins)
-    gtot = jnp.zeros(num_nodes, jnp.float32).at[node].add(gm)
-    htot = jnp.zeros(num_nodes, jnp.float32).at[node].add(hm)
-    return ghist, hhist, gtot, htot
-
 
 def _best_splits_sparse(ghist: np.ndarray, hhist: np.ndarray,
                         gtot_n: np.ndarray, htot_n: np.ndarray,
@@ -537,6 +533,9 @@ class GBDT:
 
     def __init__(self, cfg: GBDTConfig,
                  runtime: Optional[MeshRuntime] = None):
+        from wormhole_tpu.utils.config import check_choice
+        check_choice("gbdt_hist_kernel", cfg.gbdt_hist_kernel,
+                     histmm.KERNELS)
         self.cfg = cfg
         self.rt = runtime or MeshRuntime.create()
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
@@ -546,6 +545,13 @@ class GBDT:
         self.base_margin = float(np.log(cfg.base_score
                                         / (1 - cfg.base_score)))
         self.history: List[float] = []  # train metric per round
+        # per-pass counters (feed_stall convention from the ingest
+        # pipeline): hist-kernel seconds and chunk-feed consumer stalls
+        # accumulate in the timer and mirror into the mergeable Progress
+        self.timer = Timer()
+        self.progress = Progress()
+        self._last_hist = 0.0
+        self._last_stall = 0.0
 
     def _row_shards(self) -> int:
         """How many ways the local row arrays are sharded (and therefore
@@ -577,6 +583,53 @@ class GBDT:
             lmesh = self._local_mesh = Mesh(np.asarray(local), (DATA_AXIS,))
         return jax.device_put(arr, NamedSharding(lmesh, P(DATA_AXIS)))
 
+    # -- external-memory chunk feed (data/pipeline.py DeviceFeed) -----------
+
+    def _stream_chunks(self, cache: "BinnedCache"):
+        """Stream cache chunks through the ingest DeviceFeed so the next
+        chunk's disk read overlaps device compute on the current one;
+        payloads arrive device-resident as (row_offset, bins). On a local
+        cache the prep workers pread chunks concurrently (each read opens
+        its own handle); a remote cache (s3://, hdfs://) falls back to a
+        sequential single-handle read on the dispatcher thread, which
+        still overlaps the consumer. ``pipeline_workers=0`` is the serial
+        oracle (every stage inline, same accounting)."""
+        from wormhole_tpu.data.pipeline import DeviceFeed
+        cfg = self.cfg
+        workers = max(int(cfg.pipeline_workers), 0)
+        ring = max(int(cfg.pipeline_ring), 1)
+
+        def transfer(item):
+            lo, b = item
+            return lo, jnp.asarray(b)
+
+        if "://" not in cache.path:
+            return DeviceFeed(range(cache.num_chunks),
+                              lambda c, _ctx: cache.read_chunk(c),
+                              workers=workers, ring_depth=ring,
+                              transfer=transfer, name="gbdt-chunk")
+        return DeviceFeed(iter(cache), None, workers=workers,
+                          ring_depth=ring, transfer=transfer,
+                          name="gbdt-chunk")
+
+    def _drain_chunk_stats(self, feed) -> None:
+        """Fold one cache pass's feed counters into the timer
+        (``gbdt_chunk_*`` scopes) and the mergeable Progress slots."""
+        snap = feed.drain_stats(self.timer, "gbdt_chunk_")
+        self.progress.feed_batches += snap["batches"]
+
+    def _round_counters(self):
+        """Per-round (hist seconds, chunk-stall seconds) deltas; the
+        cumulative totals mirror into the Progress slots the per-pass
+        progress row reports (feed_stall convention, PR 1)."""
+        ht = self.timer.totals.get("gbdt_hist", 0.0)
+        st = self.timer.totals.get("gbdt_chunk_feed_stall", 0.0)
+        dh, ds = ht - self._last_hist, st - self._last_stall
+        self._last_hist, self._last_stall = ht, st
+        self.progress.gbdt_hist = ht
+        self.progress.gbdt_chunk_stall = st
+        return dh, ds
+
     # -- one tree -----------------------------------------------------------
 
     def _build_tree(self, bins: jax.Array, grad: jax.Array,
@@ -595,17 +648,45 @@ class GBDT:
         node = jnp.zeros(n, jnp.int32)      # local id within current level
         row_mask = jnp.asarray(data_mask)   # 0 once parked on a leaf
         active = np.ones(1, bool)
+        prev_gh = prev_hh = None    # previous level's GLOBAL hists (f64)
         for depth in range(d + 1):
             level_nodes = 2 ** depth
             offset = level_nodes - 1        # first global id of this level
-            ghist, hhist = _level_hists(
-                bins, node, grad, hess, row_mask,
-                num_nodes=level_nodes, num_bins=cfg.num_bins)
-            # the per-level histogram allreduce (rabit → host collective);
-            # identity on a single process
-            ghist, hhist = allreduce_tree(
-                (np.asarray(ghist), np.asarray(hhist)), self.rt.mesh,
-                compress=cfg.msg_compression)
+            if depth == 0:
+                with self.timer.scope("gbdt_hist"):
+                    gl, hl = _level_hists(
+                        bins, node, grad, hess, row_mask,
+                        num_nodes=1, num_bins=cfg.num_bins,
+                        kernel=cfg.gbdt_hist_kernel)
+                    gl, hl = np.asarray(gl), np.asarray(hl)
+                # the per-level histogram allreduce (rabit → host
+                # collective); identity on a single process
+                gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
+                                        compress=cfg.msg_compression)
+                ghist = gl.astype(np.float64)
+                hhist = hl.astype(np.float64)
+            else:
+                # subtraction trick (LightGBM parent − sibling): histogram
+                # only LEFT children at half the one-hot width (slot =
+                # node >> 1, right-child rows masked out) and derive each
+                # right child as parent − left after the allreduce. Under
+                # jit's static shapes masked rows cost the same flops
+                # either way, so "smaller child" degenerates to a fixed
+                # choice — left keeps reconstruction collective-free and
+                # bit-identical across hosts — and the win is the halved
+                # matmul width and allreduce payload.
+                sel = row_mask * (node % 2 == 0)
+                with self.timer.scope("gbdt_hist"):
+                    gl, hl = _level_hists(
+                        bins, node // 2, grad, hess, sel,
+                        num_nodes=level_nodes // 2, num_bins=cfg.num_bins,
+                        kernel=cfg.gbdt_hist_kernel)
+                    gl, hl = np.asarray(gl), np.asarray(hl)
+                gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
+                                        compress=cfg.msg_compression)
+                ghist, hhist = _sibling_hists(gl, hl, prev_gh, prev_hh,
+                                              active)
+            prev_gh, prev_hh = ghist, hhist
             do_split, bf, bb, leaf_w = _best_splits(
                 ghist, hhist, active, lam=cfg.reg_lambda, gamma=cfg.gamma,
                 min_child=cfg.min_child_weight)
@@ -724,9 +805,10 @@ class GBDT:
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
-            log.info("round %d: train %s=%.6f", r,
+            dh, _ = self._round_counters()
+            log.info("round %d: train %s=%.6f (hist %.2fs)", r,
                      "logloss" if cfg.objective == "binary:logistic"
-                     else "mse", metric)
+                     else "mse", metric, dh)
             self._save_checkpoint(r + 1)
         return self
 
@@ -831,9 +913,13 @@ class GBDT:
         margin = np.full(n, self.base_margin, np.float32)
         if self.trees:
             # resumed: replay the checkpointed trees' margins per chunk
-            for lo, b in cache:
-                margin[lo:lo + len(b)] = np.asarray(
-                    self._margin(b, len(self.trees)))
+            feed = self._stream_chunks(cache)
+            try:
+                for lo, b in feed:
+                    margin[lo:lo + len(b)] = np.asarray(
+                        self._margin(b, len(self.trees)))
+            finally:
+                self._drain_chunk_stats(feed)
         for r in range(start_round, cfg.num_round):
             tree = self._build_tree_external(cache, margin, labels_np,
                                              mask_np)
@@ -843,28 +929,34 @@ class GBDT:
                         default_right=tree.default_right)
             self.trees.append(tree)
             num_l = den_l = 0.0
-            for lo, b in cache:
-                sl = slice(lo, lo + len(b))
-                margin[sl] += np.asarray(_predict_trees(
-                    tree.feature[None], tree.split_bin[None],
-                    tree.is_leaf[None], tree.weight[None],
-                    jnp.asarray(b), depth=cfg.max_depth + 1))
-                m = jnp.asarray(margin[sl])
-                lab = jnp.asarray(labels_np[sl])
-                mk = jnp.asarray(mask_np[sl])
-                d = float(jnp.sum(mk))
-                den_l += d
-                if cfg.objective == "binary:logistic":
-                    num_l += float(logloss(lab, m, mk)) * d
-                else:
-                    num_l += float(jnp.sum((m - lab) ** 2 * mk))
+            feed = self._stream_chunks(cache)
+            try:
+                for lo, b in feed:
+                    sl = slice(lo, lo + len(b))
+                    margin[sl] += np.asarray(_predict_trees(
+                        tree.feature[None], tree.split_bin[None],
+                        tree.is_leaf[None], tree.weight[None],
+                        b, depth=cfg.max_depth + 1))
+                    m = jnp.asarray(margin[sl])
+                    lab = jnp.asarray(labels_np[sl])
+                    mk = jnp.asarray(mask_np[sl])
+                    d = float(jnp.sum(mk))
+                    den_l += d
+                    if cfg.objective == "binary:logistic":
+                        num_l += float(logloss(lab, m, mk)) * d
+                    else:
+                        num_l += float(jnp.sum((m - lab) ** 2 * mk))
+            finally:
+                self._drain_chunk_stats(feed)
             num, den = allreduce_tree(
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
-            log.info("round %d: train %s=%.6f (external, %d chunks)", r,
+            dh, ds = self._round_counters()
+            log.info("round %d: train %s=%.6f (external, %d chunks, "
+                     "hist %.2fs, chunk_stall %.2fs)", r,
                      "logloss" if cfg.objective == "binary:logistic"
-                     else "mse", metric, cache.num_chunks)
+                     else "mse", metric, cache.num_chunks, dh, ds)
             self._save_checkpoint(r + 1)
         return self
 
@@ -887,23 +979,46 @@ class GBDT:
         node = np.zeros(n, np.int32)
         alive = mask_np.copy()
         active = np.ones(1, bool)
+        prev_gh = prev_hh = None    # previous level's GLOBAL hists (f64)
         for depth in range(d + 1):
             level_nodes = 2 ** depth
             offset = level_nodes - 1
+            slots = 1 if depth == 0 else level_nodes // 2
             gh = hh = None
-            for lo, b in cache:
-                sl = slice(lo, lo + len(b))
-                g, h = _grad_hess(jnp.asarray(margin[sl]),
-                                  jnp.asarray(labels_np[sl]),
-                                  cfg.objective)
-                gc, hc = _level_hists(
-                    jnp.asarray(b), jnp.asarray(node[sl]), g, h,
-                    jnp.asarray(alive[sl]),
-                    num_nodes=level_nodes, num_bins=cfg.num_bins)
-                gh = np.asarray(gc) if gh is None else gh + np.asarray(gc)
-                hh = np.asarray(hc) if hh is None else hh + np.asarray(hc)
+            feed = self._stream_chunks(cache)
+            try:
+                for lo, b in feed:
+                    sl = slice(lo, lo + len(b))
+                    g, h = _grad_hess(jnp.asarray(margin[sl]),
+                                      jnp.asarray(labels_np[sl]),
+                                      cfg.objective)
+                    nd = node[sl]
+                    if depth == 0:
+                        slot, mk = nd, alive[sl]
+                    else:
+                        # left children only (subtraction trick — see
+                        # _build_tree): half-width slots, right-child
+                        # rows masked
+                        slot = nd >> 1
+                        mk = alive[sl] * (nd % 2 == 0)
+                    with self.timer.scope("gbdt_hist"):
+                        gc, hc = _level_hists(
+                            b, jnp.asarray(slot), g, h, jnp.asarray(mk),
+                            num_nodes=slots, num_bins=cfg.num_bins,
+                            kernel=cfg.gbdt_hist_kernel)
+                        gc, hc = np.asarray(gc), np.asarray(hc)
+                    gh = gc if gh is None else gh + gc
+                    hh = hc if hh is None else hh + hc
+            finally:
+                self._drain_chunk_stats(feed)
             gh, hh = allreduce_tree((gh, hh), self.rt.mesh,
                                     compress=cfg.msg_compression)
+            if depth == 0:
+                gh = gh.astype(np.float64)
+                hh = hh.astype(np.float64)
+            else:
+                gh, hh = _sibling_hists(gh, hh, prev_gh, prev_hh, active)
+            prev_gh, prev_hh = gh, hh
             do_split, bf, bb, leaf_w = _best_splits(
                 gh, hh, active, lam=cfg.reg_lambda, gamma=cfg.gamma,
                 min_child=cfg.min_child_weight)
@@ -918,14 +1033,17 @@ class GBDT:
             if not do_split.any():
                 break
             bfj, bbj = jnp.asarray(bf), jnp.asarray(bb)
-            for lo, b in cache:
-                sl = slice(lo, lo + len(b))
-                go = np.asarray(_route_rows(jnp.asarray(b),
-                                            jnp.asarray(node[sl]),
-                                            bfj, bbj))
-                on_split = do_split[node[sl]]
-                node[sl] = np.where(on_split, 2 * node[sl] + go, 0)
-                alive[sl] *= on_split
+            feed = self._stream_chunks(cache)
+            try:
+                for lo, b in feed:
+                    sl = slice(lo, lo + len(b))
+                    go = np.asarray(_route_rows(b, jnp.asarray(node[sl]),
+                                                bfj, bbj))
+                    on_split = do_split[node[sl]]
+                    node[sl] = np.where(on_split, 2 * node[sl] + go, 0)
+                    alive[sl] *= on_split
+            finally:
+                self._drain_chunk_stats(feed)
             nxt_active = np.zeros(2 * level_nodes, bool)
             sp = np.nonzero(do_split)[0]
             nxt_active[2 * sp] = True
@@ -953,16 +1071,36 @@ class GBDT:
         node = jnp.zeros(num_rows, jnp.int32)
         row_mask = jnp.asarray(row_mask)
         active = np.ones(1, bool)
+        prev = None     # previous level's GLOBAL (gh, hh, gt, ht), f64
         for depth in range(d + 1):
             level_nodes = 2 ** depth
             offset = level_nodes - 1
-            gh, hh, gt, ht = _level_hists_sparse(
-                er, ef, eb, node, grad, hess, row_mask,
-                num_nodes=level_nodes, num_bins=cfg.num_bins,
-                num_feat=num_feat)
-            gh, hh, gt, ht = allreduce_tree(
-                tuple(np.asarray(a) for a in (gh, hh, gt, ht)),
-                self.rt.mesh, compress=cfg.msg_compression)
+            if depth == 0:
+                slot, sel, slots = node, row_mask, 1
+            else:
+                # left children only (subtraction trick — see
+                # _build_tree); the per-node totals subtract the same way
+                slot = node // 2
+                sel = row_mask * (node % 2 == 0)
+                slots = level_nodes // 2
+            with self.timer.scope("gbdt_hist"):
+                gl, hl, gtl, htl = _level_hists_sparse(
+                    er, ef, eb, slot, grad, hess, sel,
+                    num_nodes=slots, num_bins=cfg.num_bins,
+                    num_feat=num_feat, kernel=cfg.gbdt_hist_kernel)
+                gl, hl, gtl, htl = (np.asarray(a)
+                                    for a in (gl, hl, gtl, htl))
+            gl, hl, gtl, htl = allreduce_tree(
+                (gl, hl, gtl, htl), self.rt.mesh,
+                compress=cfg.msg_compression)
+            if depth == 0:
+                gh, hh, gt, ht = (a.astype(np.float64)
+                                  for a in (gl, hl, gtl, htl))
+            else:
+                gh, hh = _sibling_hists(gl, hl, prev[0], prev[1], active)
+                gt, ht = _sibling_hists(gtl, htl, prev[2], prev[3],
+                                        active)
+            prev = (gh, hh, gt, ht)
             do_split, bf, bb, dr, leaf_w = _best_splits_sparse(
                 gh, hh, gt, ht, active, lam=cfg.reg_lambda,
                 gamma=cfg.gamma, min_child=cfg.min_child_weight)
@@ -1045,9 +1183,10 @@ class GBDT:
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
-            log.info("round %d: train %s=%.6f", r,
+            dh, _ = self._round_counters()
+            log.info("round %d: train %s=%.6f (hist %.2fs)", r,
                      "logloss" if cfg.objective == "binary:logistic"
-                     else "mse", metric)
+                     else "mse", metric, dh)
             self._save_checkpoint(r + 1)
         return self
 
@@ -1297,6 +1436,23 @@ class BinnedCache:
     @property
     def num_chunks(self) -> int:
         return -(-self.total // self.chunk_rows) if self.total else 0
+
+    def read_chunk(self, c: int):
+        """Random-access read of chunk ``c`` → (row_offset, bins u8).
+        Opens its own handle per call, so concurrent readers (DeviceFeed
+        prep workers) never race a shared seek position."""
+        from wormhole_tpu.data.stream import open_stream
+        F = self.num_features
+        lo = c * self.chunk_rows
+        rows = min(self.chunk_rows, self.total - lo)
+        if rows <= 0:
+            raise IndexError(f"{self.path}: chunk {c} out of range")
+        with open_stream(self.path, "rb") as f:
+            f.seek(self._HDR.size + lo * F)
+            raw = f.read(rows * F)
+        if len(raw) != rows * F:
+            raise IOError(f"{self.path}: truncated chunk {c}")
+        return lo, np.frombuffer(raw, np.uint8).reshape(rows, F)
 
     def __iter__(self):
         """Yield (row_offset, bins u8 (r, F)) — one chunk resident at a

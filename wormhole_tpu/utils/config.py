@@ -151,6 +151,17 @@ class Config:
         return out
 
 
+def check_choice(name: str, value: str, choices: Sequence[str]) -> str:
+    """Validate a string-enum config knob at construction time (shared
+    by model configs whose dataclass fields are plain ``str`` — e.g.
+    ``gbdt_hist_kernel`` — so a typo'd ``key=val`` CLI token fails fast
+    instead of deep inside a training pass)."""
+    if value not in choices:
+        raise ValueError(
+            f"{name} must be one of {tuple(choices)}, got {value!r}")
+    return value
+
+
 _ALIASES = {
     "lambda": "lambda_",
     "size_memory": "lbfgs_memory",

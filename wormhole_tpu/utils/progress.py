@@ -22,8 +22,11 @@ _NI = 10  # int slots
 # slot names, mirroring monitor.h field accessors; feed_stall/feed_batches
 # carry the ingest-pipeline counters (data/pipeline.py DeviceFeed): seconds
 # the compute loop waited on the feed ring, and batches it delivered —
-# mergeable across parts/hosts like every other slot
-_F_SLOTS = ["objv", "acc", "auc", "objv_w", "wdelta2", "feed_stall"]
+# mergeable across parts/hosts like every other slot. gbdt_hist /
+# gbdt_chunk_stall are the GBDT analogues (ops/histmm level-hist kernel
+# seconds, external-memory chunk-feed consumer stalls), same convention.
+_F_SLOTS = ["objv", "acc", "auc", "objv_w", "wdelta2", "feed_stall",
+            "gbdt_hist", "gbdt_chunk_stall"]
 _I_SLOTS = ["count", "num_ex", "nnz_w", "nnz_delta", "new_ex",
             "feed_batches"]
 
@@ -64,6 +67,10 @@ class Progress:
                           lambda s, v: s._fset("feed_stall", v))
     feed_batches = property(lambda s: s._iget("feed_batches"),
                             lambda s, v: s._iset("feed_batches", v))
+    gbdt_hist = property(lambda s: s._fget("gbdt_hist"),
+                         lambda s, v: s._fset("gbdt_hist", v))
+    gbdt_chunk_stall = property(lambda s: s._fget("gbdt_chunk_stall"),
+                                lambda s, v: s._fset("gbdt_chunk_stall", v))
 
     # --- POD contract ---
     def serialize(self) -> bytes:

@@ -775,9 +775,30 @@ _CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
 _DEFAULT_BUDGET = 840.0  # under the 15-min harness timeout, with margin
 
 
+def _phase_telemetry() -> dict:
+    """Per-phase telemetry record from the trace ring (span totals,
+    stall fractions) plus any straggler flags visible in the heartbeat
+    directory. Caller resets the ring between phases."""
+    from wormhole_tpu.obs import (trace, read_heartbeats,
+                                  StragglerDetector)
+    spans = trace.summary()
+    stall_s = sum(v["total_s"] for k, v in spans.items()
+                  if k.endswith("_stall"))
+    busy_s = sum(v["total_s"] for k, v in spans.items()
+                 if not k.endswith("_stall"))
+    rec = {"spans": spans,
+           "stall_sec": round(stall_s, 3),
+           "stall_frac": round(stall_s / max(stall_s + busy_s, 1e-9), 4)}
+    hb_dir = os.environ.get("WORMHOLE_METRICS_EXPORT", "")
+    if hb_dir:
+        rec["straggler_flags"] = StragglerDetector().check(
+            read_heartbeats(hb_dir))
+    return rec
+
+
 def _summarize(results: dict, failed: dict, skipped: list, pending: list,
                kind: str, peak_hbm, peak_mxu, budget: float,
-               elapsed: float) -> dict:
+               elapsed: float, telemetry: dict = None) -> dict:
     """Build the summary JSON object from whatever phases have finished
     so far. Called after EVERY phase (not just at exit) so the --out
     file always holds the latest complete snapshot."""
@@ -850,6 +871,8 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
             k: (round(v, 1) if isinstance(v, float)
                 and not k.endswith("speedup") else v)
             for k, v in text.items()}
+    if telemetry:
+        extra["telemetry"] = telemetry
     return {
         "metric": "end_to_end_examples_per_sec",
         "value": round(value, 1) if value is not None else None,
@@ -890,6 +913,15 @@ def main(argv=None) -> None:
                          "already-measured numbers on disk (empty "
                          "string disables the file; stdout always gets "
                          "the final one-line JSON)")
+    ap.add_argument("--telemetry", dest="telemetry", default=True,
+                    action="store_true",
+                    help="record per-phase span telemetry into the "
+                         "summary (ring-only, no extra files; default on)")
+    ap.add_argument("--no-telemetry", dest="telemetry",
+                    action="store_false")
+    ap.add_argument("--trace-path", default="",
+                    help="also write the accumulated spans as Chrome "
+                         "trace-event JSON (view at ui.perfetto.dev)")
     args = ap.parse_args(argv)
     sel = [p.strip() for p in args.phases.split(",") if p.strip()] \
         if args.phases else list(PHASES)
@@ -941,6 +973,14 @@ def main(argv=None) -> None:
     results: dict = {}
     skipped: list = []
     failed: dict = {}
+    telemetry: dict = {}
+    trace_events: list = []
+    if args.telemetry:
+        # ring-only span recording (no files unless --trace-path); the
+        # per-phase summaries land in the --out JSON, which records
+        # where the time went, not just how much
+        from wormhole_tpu.obs import trace
+        trace.enable(args.trace_path, ring=1 << 18)
     bench_t0 = time.perf_counter()
     todo = [p for p in PHASES if p in sel]
 
@@ -951,7 +991,7 @@ def main(argv=None) -> None:
             return
         summary = _summarize(results, failed, skipped, pending, kind,
                              peak_hbm, peak_mxu, args.budget,
-                             time.perf_counter() - bench_t0)
+                             time.perf_counter() - bench_t0, telemetry)
         try:
             _write_summary(args.out, summary)
         except OSError as e:
@@ -977,10 +1017,30 @@ def main(argv=None) -> None:
             print(f"[bench] {name} done in "
                   f"{time.perf_counter() - t0:.0f}s",
                   file=sys.stderr, flush=True)
+        if args.telemetry:
+            from wormhole_tpu.obs import trace
+            telemetry[name] = _phase_telemetry()
+            telemetry[name]["phase_sec"] = round(
+                time.perf_counter() - t0, 3)
+            if args.trace_path:
+                trace_events.extend(trace.events())
+            trace.reset()        # each phase gets the whole ring
         checkpoint(todo[i + 1:])
         if stores_box and not any(p in _STORE_PHASES
                                   for p in todo[i + 1:]):
             stores_box.clear()   # free the HBM tables for later phases
+
+    if args.telemetry and args.trace_path:
+        from wormhole_tpu.obs import trace
+        trace_events.extend(trace.events())
+        try:
+            trace.write_trace(args.trace_path, trace_events)
+            print(f"[bench] trace written to {args.trace_path} "
+                  f"({len(trace_events)} events; view at "
+                  "ui.perfetto.dev)", file=sys.stderr, flush=True)
+        except OSError as e:
+            print(f"[bench] cannot write {args.trace_path}: {e}",
+                  file=sys.stderr, flush=True)
 
     for p in (crec2_path, text_path):
         try:
@@ -990,7 +1050,7 @@ def main(argv=None) -> None:
 
     summary = _summarize(results, failed, skipped, [], kind, peak_hbm,
                          peak_mxu, args.budget,
-                         time.perf_counter() - bench_t0)
+                         time.perf_counter() - bench_t0, telemetry)
     if args.out:
         try:
             _write_summary(args.out, summary)

@@ -16,10 +16,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def parse_num_ex(out: str):
     """Line-anchored per-rank ``num_ex`` parse (the launcher merges rank
-    output line-atomically; anchoring makes the parse robust even if a
-    rank's line is preceded by other output)."""
+    output line-atomically and prefixes each line with its ``[w<rank>]``
+    tag; anchoring makes the parse robust even if a rank's line is
+    preceded by other output)."""
     vals = [int(m) for m in
-            re.findall(r"^OK rank \d+ num_ex=(\d+)", out, re.M)]
+            re.findall(r"^(?:\[w\d+\] )?OK rank \d+ num_ex=(\d+)",
+                       out, re.M)]
     assert vals, f"no 'OK rank N num_ex=' line in:\n{out}"
     return vals
 

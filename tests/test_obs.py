@@ -1,0 +1,444 @@
+"""Unified telemetry (wormhole_tpu/obs/): span tracing, metrics
+registry, heartbeat/straggler detection, and their learner/launcher/
+bench integration points.
+
+Pins the PR-3 contracts: trace files are Perfetto-loadable Chrome
+trace-event JSON with thread attribution; registry merge across
+simulated hosts equals serial totals; heartbeat files parse and flag
+stragglers; and with every knob off, nothing records and nothing is
+written."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from wormhole_tpu import obs
+from wormhole_tpu.obs import trace
+from wormhole_tpu.obs.metrics import Registry, merge_snapshots
+from wormhole_tpu.obs.heartbeat import (HeartbeatWriter, HeartbeatMonitor,
+                                        StragglerDetector, read_heartbeats,
+                                        heartbeat_path)
+
+
+@pytest.fixture(autouse=True)
+def _trace_off():
+    """The trace recorder is module-global state; leave it off."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+# -- span tracing ------------------------------------------------------------
+
+def test_trace_disabled_records_nothing(tmp_path):
+    assert not trace.enabled()
+    trace.complete("x", time.monotonic(), 0.01)
+    with trace.span("y"):
+        pass
+    trace.instant("z")
+    trace.counter("c", 1.0)
+    assert trace.events() == []
+    assert trace.flush(str(tmp_path / "no.json")) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_trace_json_schema_and_thread_attribution(tmp_path):
+    path = str(tmp_path / "run.trace.json")
+    trace.enable(path)
+
+    with trace.span("main:work", cat="app"):
+        time.sleep(0.001)
+    trace.instant("mark")
+    trace.counter("ring", 3)
+
+    def worker():
+        trace.complete("worker:stage", time.monotonic(), 0.002,
+                       cat="feed")
+
+    t = threading.Thread(target=worker, name="prep0")
+    t.start()
+    t.join()
+
+    assert trace.flush() == path
+    doc = json.loads(open(path).read())
+    evs = doc["traceEvents"]
+
+    complete = [e for e in evs if e["ph"] == "X"]
+    names = {e["name"] for e in complete}
+    assert {"main:work", "worker:stage"} <= names
+    for e in complete:
+        # the Chrome trace-event complete-span schema Perfetto needs
+        assert {"ph", "name", "pid", "tid", "ts", "dur"} <= set(e)
+        assert e["dur"] >= 0
+
+    # distinct threads -> distinct tids, both named via M-events
+    tids = {e["name"]: e["tid"] for e in complete}
+    assert tids["main:work"] != tids["worker:stage"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    tnames = {e["args"]["name"] for e in meta
+              if e["name"] == "thread_name"}
+    assert "prep0" in tnames
+    assert any(e["name"] == "process_name" for e in meta)
+
+    assert any(e["ph"] == "i" and e["name"] == "mark" for e in evs)
+    assert any(e["ph"] == "C" and e["args"]["value"] == 3.0 for e in evs)
+
+
+def test_trace_ring_is_bounded():
+    trace.enable(ring=16)
+    for i in range(100):
+        trace.complete(f"s{i}", time.monotonic(), 0.0)
+    evs = trace.events()
+    assert len(evs) == 16
+    assert evs[-1]["name"] == "s99"   # freshest window survives
+
+
+def test_trace_summary_aggregates():
+    trace.enable()
+    for _ in range(3):
+        trace.complete("a", time.monotonic(), 0.010)
+    trace.complete("b", time.monotonic(), 0.005)
+    s = trace.summary()
+    assert s["a"]["count"] == 3
+    assert s["a"]["total_s"] == pytest.approx(0.030)
+    assert s["b"]["count"] == 1
+
+
+def test_timer_scope_emits_spans():
+    from wormhole_tpu.utils.timer import Timer
+    trace.enable()
+    tm = Timer()
+    with tm.scope("dispatch"):
+        time.sleep(0.001)
+    names = {e["name"] for e in trace.events()}
+    assert "dispatch" in names
+    # and the timer still accumulated normally
+    assert tm.totals["dispatch"] > 0
+
+
+def test_device_feed_stage_spans_with_thread_tracks():
+    from wormhole_tpu.data.pipeline import DeviceFeed
+    trace.enable()
+    feed = DeviceFeed(range(16), lambda it, c: it, workers=2,
+                      transfer=lambda x: x, name="feed")
+    assert list(feed) == list(range(16))
+    evs = [e for e in trace.events() if e["ph"] == "X"]
+    names = {e["name"] for e in evs}
+    assert "feed:parse" in names and "feed:prep" in names \
+        and "feed:put" in names
+    # pool work is attributed to worker threads, not the consumer
+    tids = {e["name"]: set() for e in evs}
+    for e in evs:
+        tids[e["name"]].add(e["tid"])
+    assert tids["feed:prep"] != tids["feed:parse"]
+
+
+def test_collective_span_single_process():
+    import numpy as np
+    from wormhole_tpu.parallel.collectives import allreduce_tree
+    trace.enable()
+    out = allreduce_tree(np.ones(4), None, "sum")
+    assert (out == np.ones(4)).all()
+    assert "collective:allreduce_sum" in {e["name"]
+                                          for e in trace.events()}
+
+
+def test_xla_profile_degrades_to_noop():
+    # bad logdir / unavailable profiler must not raise
+    with trace.xla_profile(""):
+        pass
+
+
+# -- metrics registry --------------------------------------------------------
+
+def _load_host(reg, scale):
+    reg.counter("steps").inc(10 * scale)
+    reg.gauge("nnz", agg="sum").set(100.0 * scale)
+    reg.gauge("ring_max", agg="max").set(float(scale))
+    reg.gauge("t_min", agg="min").set(float(scale))
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        for _ in range(scale):
+            h.observe(v)
+
+
+def test_merge_across_hosts_equals_serial():
+    hosts = []
+    for scale in (1, 2, 3):
+        r = Registry()
+        _load_host(r, scale)
+        hosts.append(r)
+    merged = merge_snapshots([r.snapshot() for r in hosts])
+
+    serial = Registry()
+    _load_host(serial, 1 + 2 + 3)
+
+    assert merged.get("steps").value == serial.get("steps").value
+    assert merged.get("nnz").value == serial.get("nnz").value
+    assert merged.get("ring_max").value == 3.0
+    assert merged.get("t_min").value == 1.0
+    assert merged.get("lat").bins == serial.get("lat").bins
+    assert merged.get("lat").count == serial.get("lat").count
+    assert merged.get("lat").sum == pytest.approx(serial.get("lat").sum)
+
+
+def test_registry_redeclare_and_kind_guard():
+    r = Registry()
+    c = r.counter("x")
+    assert r.counter("x") is c            # same name+kind: same object
+    with pytest.raises(ValueError):
+        r.gauge("x")                      # kind collision fails loud
+    with pytest.raises(ValueError):
+        c.inc(-1)                         # counters only go up
+
+
+def test_registry_allreduce_single_process_identity():
+    r = Registry()
+    _load_host(r, 2)
+    before = r.snapshot()
+    r.allreduce(None)                     # process_count == 1: identity
+    assert r.snapshot() == before
+
+
+def test_prometheus_text_format():
+    r = Registry()
+    r.counter("steps", help="device steps").inc(5)
+    h = r.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.prometheus_text(labels={"host": "2"})
+    assert "# TYPE steps counter" in text
+    assert 'steps{host="2"} 5.0' in text
+    assert "# HELP steps device steps" in text
+    # cumulative le buckets + the +Inf bucket equal to count
+    assert 'lat_bucket{host="2",le="0.1"} 1' in text
+    assert 'lat_bucket{host="2",le="1.0"} 2' in text
+    assert 'lat_bucket{host="2",le="+Inf"} 3' in text
+    assert 'lat_count{host="2"} 3' in text
+
+
+def test_adapters_timer_progress_feed():
+    from wormhole_tpu.utils.timer import Timer
+    from wormhole_tpu.utils.progress import Progress
+    r = Registry()
+    tm = Timer()
+    with tm.scope("dispatch"):
+        pass
+    r.from_timer(tm)
+    assert r.get("timer_dispatch_calls").value == 1.0
+    assert r.get("timer_dispatch_seconds").value >= 0.0
+
+    p = Progress()
+    p.num_ex = 123
+    p.feed_stall = 4.5
+    r.from_progress(p)
+    assert r.get("progress_num_ex").value == 123.0
+    assert r.get("progress_feed_stall").value == 4.5
+    assert r.get("progress_num_ex").agg == "sum"
+
+    r.ingest_feed({"parse": 1.0, "batches": 7, "ring_max": 2})
+    r.ingest_feed({"parse": 0.5, "batches": 3, "ring_max": 1})
+    assert r.get("feed_parse_seconds").value == 1.5
+    assert r.get("feed_batches").value == 10.0
+    assert r.get("feed_ring_max").value == 2.0
+
+
+def test_registry_record_flat_dict():
+    r = Registry()
+    r.counter("steps").inc(2)
+    r.histogram("lat", buckets=(1.0,)).observe(0.5)
+    rec = r.record(rank=3, step=10)
+    assert rec["rank"] == 3 and rec["step"] == 10
+    assert rec["steps"] == 2.0
+    assert rec["lat_count"] == 1 and "ts" in rec
+    json.dumps(rec)   # JSON-lines-able
+
+
+# -- heartbeats & stragglers -------------------------------------------------
+
+def test_heartbeat_write_read_roundtrip(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), rank=2, interval=30.0)
+    assert hb.beat(step=1, num_ex=100)            # first beat: immediate
+    assert not hb.beat(step=2, num_ex=200)        # rate-limited
+    assert hb.beat(step=3, num_ex=300, force=True)
+    hb.close(step=3, num_ex=300)
+
+    by_rank = read_heartbeats(str(tmp_path))
+    recs = by_rank[2]
+    assert len(recs) == 3
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+    assert all(r["rank"] == 2 for r in recs)
+    assert recs[-1]["final"] is True
+    assert recs[1]["ex_per_sec"] > 0              # delta-based rate
+
+
+def test_heartbeat_torn_line_skipped(tmp_path):
+    p = heartbeat_path(str(tmp_path), 0)
+    with open(p, "w") as f:
+        f.write(json.dumps({"rank": 0, "seq": 0, "ex_per_sec": 5.0})
+                + "\n")
+        f.write('{"rank": 0, "seq": 1, "ex_per')   # writer mid-append
+    assert len(read_heartbeats(str(tmp_path))[0]) == 1
+
+
+def test_heartbeat_unwritable_never_raises(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), rank=0)
+    # occupy the writer's path with a directory (chmod tricks don't
+    # work under root): open(path, "a") raises OSError
+    os.mkdir(hb.path)
+    assert hb.beat(step=1, num_ex=1) is False       # dead, not raising
+    assert hb.beat(step=2, num_ex=2) is False
+
+
+def _hb_files(tmp_path, rates):
+    for rank, rate in rates.items():
+        with open(heartbeat_path(str(tmp_path), rank), "w") as f:
+            f.write(json.dumps({"rank": rank, "seq": 0,
+                                "ex_per_sec": rate}) + "\n")
+
+
+def test_straggler_detection(tmp_path):
+    _hb_files(tmp_path, {0: 100.0, 1: 110.0, 2: 10.0, 3: 95.0})
+    flags = StragglerDetector(factor=3.0).check(
+        read_heartbeats(str(tmp_path)))
+    assert [f["rank"] for f in flags] == [2]
+    assert flags[0]["ex_per_sec"] == 10.0
+    assert flags[0]["floor"] < flags[0]["median"]
+    # nobody below median/factor -> no flags
+    _hb_files(tmp_path, {0: 100.0, 1: 110.0, 2: 90.0, 3: 95.0})
+    assert StragglerDetector(factor=3.0).check(
+        read_heartbeats(str(tmp_path))) == []
+
+
+def test_monitor_warns_once_per_rank(tmp_path):
+    _hb_files(tmp_path, {0: 100.0, 1: 100.0, 2: 1.0})
+    warnings = []
+    mon = HeartbeatMonitor(str(tmp_path), factor=3.0,
+                           sink=warnings.append, rewarn_after=3600.0)
+    assert [f["rank"] for f in mon.scan_once()] == [2]
+    mon.scan_once()                       # same straggler: rate-limited
+    assert len(warnings) == 1
+    assert "straggler: w2" in warnings[0]
+
+
+# -- the Obs hub -------------------------------------------------------------
+
+def _cfg(**kw):
+    from wormhole_tpu.utils.config import Config
+    return Config(**kw)
+
+
+def test_obs_disabled_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.delenv(obs.METRICS_EXPORT_ENV, raising=False)
+    monkeypatch.chdir(tmp_path)
+    hub = obs.setup(_cfg(), rank=0, registry=Registry())
+    assert not hub.active
+    assert not trace.enabled()
+    hub.heartbeat_tick(step=1, num_ex=10)
+    hub.finalize(step=1, num_ex=10, timer=None, progress=None)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_obs_enabled_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.delenv(obs.METRICS_EXPORT_ENV, raising=False)
+    from wormhole_tpu.utils.timer import Timer
+    trace_path = str(tmp_path / "t.json")
+    export = str(tmp_path / "telemetry")
+    hub = obs.setup(_cfg(trace_path=trace_path, metrics_export=export,
+                         heartbeat_itv=0.0),
+                    rank=0, registry=Registry())
+    assert hub.active and trace.enabled()
+
+    tm = Timer()
+    with tm.scope("dispatch"):
+        pass
+    hub.heartbeat_tick(step=1, num_ex=100)
+    hub.finalize(step=2, num_ex=200, timer=tm, progress=None)
+
+    # all three artifact kinds exist and parse
+    doc = json.loads(open(trace_path).read())
+    assert any(e["name"] == "dispatch" for e in doc["traceEvents"])
+    recs = read_heartbeats(export)[0]
+    assert recs[-1]["final"] is True
+    prom = open(os.path.join(export, "host0.prom")).read()
+    assert 'timer_dispatch_calls{host="0"} 1.0' in prom
+
+
+def test_obs_env_fallback_and_rank_path(tmp_path, monkeypatch):
+    export = str(tmp_path / "hb")
+    monkeypatch.setenv(obs.METRICS_EXPORT_ENV, export)
+    hub = obs.setup(_cfg(trace_path=str(tmp_path / "t.json")), rank=3,
+                    registry=Registry())
+    assert hub.export_dir == export       # launcher env fallback
+    assert hub.trace_path.endswith("t.r3.json")   # per-rank trace file
+    hub.heartbeat_tick(step=1, num_ex=1)
+    assert os.path.exists(heartbeat_path(export, 3))
+
+
+# -- satellite integrations --------------------------------------------------
+
+def test_progress_slot_overflow_raises_with_names():
+    from wormhole_tpu.utils import progress as P
+    assert P.Progress.names() == (tuple(P._F_SLOTS), tuple(P._I_SLOTS))
+    orig = list(P._F_SLOTS)
+    try:
+        P._F_SLOTS[:] = [f"s{i}" for i in range(11)]
+        with pytest.raises(ValueError, match="s10"):
+            P._check_slots()
+        P._F_SLOTS[:] = ["a", "b", "a"]
+        with pytest.raises(ValueError, match="duplicate"):
+            P._check_slots()
+    finally:
+        P._F_SLOTS[:] = orig
+
+
+def test_time_reporter_first_delay():
+    from wormhole_tpu.utils.progress import TimeReporter
+    fired = []
+    immediate = TimeReporter(fired.append, interval=60.0)
+    assert immediate.due()                # default: t=0 row fires
+    delayed = TimeReporter(fired.append, interval=60.0, first_delay=True)
+    assert not delayed.due()              # heartbeat-style: waits
+
+
+def test_pump_lines_rank_prefix():
+    from wormhole_tpu.parallel.launcher import _pump_lines
+    sink = io.BytesIO()
+    sink.flush = lambda: None
+    _pump_lines(io.BytesIO(b"hello\nworld\n"), sink, threading.Lock(),
+                tag=b"[w3] ")
+    assert sink.getvalue() == b"[w3] hello\n[w3] world\n"
+    # no tag: verbatim relay (sim mode, single child)
+    sink2 = io.BytesIO()
+    sink2.flush = lambda: None
+    _pump_lines(io.BytesIO(b"x\n"), sink2, threading.Lock())
+    assert sink2.getvalue() == b"x\n"
+
+
+def test_bench_phase_telemetry(monkeypatch):
+    import bench
+    monkeypatch.delenv(obs.METRICS_EXPORT_ENV, raising=False)
+    trace.enable()
+    trace.complete("feed:parse", time.monotonic(), 0.03)
+    trace.complete("feed:consume_stall", time.monotonic(), 0.01)
+    rec = bench._phase_telemetry()
+    assert rec["spans"]["feed:parse"]["count"] == 1
+    assert rec["stall_sec"] == pytest.approx(0.01, abs=1e-3)
+    assert rec["stall_frac"] == pytest.approx(0.25, abs=0.01)
+    assert "straggler_flags" not in rec   # no heartbeat dir configured
+
+
+def test_bench_summarize_telemetry_passthrough():
+    import bench
+    tele = {"e2e": {"spans": {}, "stall_sec": 0.0, "stall_frac": 0.0}}
+    out = bench._summarize({}, {}, [], [], "cpu", None, None, 840.0,
+                           1.0, tele)
+    assert out["extra"]["telemetry"] is tele
+    out2 = bench._summarize({}, {}, [], [], "cpu", None, None, 840.0,
+                            1.0, {})
+    assert "telemetry" not in out2["extra"]

@@ -55,6 +55,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from wormhole_tpu.obs import trace
+
 __all__ = ["DeviceFeed"]
 
 _END = object()
@@ -135,6 +137,13 @@ class DeviceFeed:
     def _acc(self, table: dict, key: str, dt: float) -> None:
         with self._lock:
             table[key] = table[key] + dt
+        # every accounted interval doubles as a trace span on the thread
+        # that did the work, so Perfetto shows dispatcher / prep pool /
+        # transfer / consumer as separate tracks with stage overlap
+        if trace.enabled():
+            suffix = "_stall" if table is self._stall else ""
+            trace.complete(f"{self.name}:{key}{suffix}",
+                           time.monotonic() - dt, dt, cat="feed")
 
     def stats(self) -> dict:
         """Snapshot: per-stage busy/stall seconds (worker seconds sum

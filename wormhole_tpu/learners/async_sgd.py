@@ -29,6 +29,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from wormhole_tpu import obs
 from wormhole_tpu.data.feed import next_bucket, nnz_bucket, pad_to_batch
 from wormhole_tpu.data.localizer import Localizer
 from wormhole_tpu.data.minibatch import MinibatchIter
@@ -121,6 +122,10 @@ class AsyncSGD:
         from wormhole_tpu.parallel.checkpoint import Checkpointer
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
         self._warned_ckpt = False
+        # telemetry hub (obs/): trace_path turns span tracing on,
+        # metrics_export turns heartbeat/Prometheus files on; both off
+        # (the default) leaves every instrumented path at one bool check
+        self.obs = obs.setup(cfg, self.rt.rank)
 
     # -- worker data path ---------------------------------------------------
 
@@ -237,7 +242,11 @@ class AsyncSGD:
 
         def harvest(item) -> None:
             metrics, labels, row_mask = item
-            metrics = jax.block_until_ready(metrics)
+            # the psum'd metric buffer flying home — the sparse-path
+            # collective boundary, same span name as the crec harvest
+            with obs.trace.span("collective:metrics_window",
+                                cat="collective"):
+                metrics = jax.block_until_ready(metrics)
             objv, num_ex, a, acc = (float(np.asarray(m))
                                     for m in metrics[:4])
             mon.update(int(num_ex), objv, a, acc)
@@ -405,7 +414,11 @@ class AsyncSGD:
         resolved = False
         while self._crec_tickets and (final or len(self._crec_tickets) > 1):
             ticket, n = self._crec_tickets.pop(0)
-            row = np.asarray(ticket)
+            # the fetched accumulator is the psum'd metric buffer — this
+            # resolve IS the collective boundary on the device step path
+            with obs.trace.span("collective:metrics_window",
+                                cat="collective"):
+                row = np.asarray(ticket)
             local.objv += float(row[0])
             local.num_ex += int(row[1])
             local.count += n
@@ -817,6 +830,12 @@ class AsyncSGD:
             self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
+        if self.obs.active:
+            self.obs.finalize(step=self.progress.count,
+                              num_ex=self.progress.num_ex,
+                              feed_stall=self.feed_stats["feed_stall"],
+                              timer=self.timer, progress=self.progress,
+                              feed_stats=None)
         return self.progress
 
     # -- multi-host synchronized training -----------------------------------
@@ -1336,6 +1355,12 @@ class AsyncSGD:
             self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
+        if self.obs.active:
+            self.obs.finalize(step=self.progress.count,
+                              num_ex=self.progress.num_ex,
+                              feed_stall=self.feed_stats["feed_stall"],
+                              timer=self.timer, progress=self.progress,
+                              feed_stats=None)
         return self.progress
 
     def _allreduce_pooled_auc(self, pooled: list) -> float:
@@ -1462,6 +1487,14 @@ class AsyncSGD:
                                   expect_key_fold=self._key_fold())
 
     def _display(self, local: Progress) -> None:
+        # heartbeat BEFORE the rank gate: every host reports its own
+        # liveness/throughput, that is the point of straggler detection
+        if self.obs.hb is not None and self.obs.hb.due():
+            snap = Progress(self.progress.fvec + local.fvec,
+                            self.progress.ivec + local.ivec)
+            self.obs.heartbeat_tick(
+                step=snap.count, num_ex=snap.num_ex,
+                feed_stall=self.feed_stats["feed_stall"])
         if self.rt.rank != 0:
             return
         self.reporter.report(local)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from wormhole_tpu.obs import trace
 from wormhole_tpu.ops import histmm
 from wormhole_tpu.ops.metrics import accuracy, auc, logloss
 from wormhole_tpu.parallel.checkpoint import Checkpointer
@@ -1447,7 +1448,8 @@ class BinnedCache:
         rows = min(self.chunk_rows, self.total - lo)
         if rows <= 0:
             raise IndexError(f"{self.path}: chunk {c} out of range")
-        with open_stream(self.path, "rb") as f:
+        with trace.span("gbdt:chunk_read", cat="io"), \
+                open_stream(self.path, "rb") as f:
             f.seek(self._HDR.size + lo * F)
             raw = f.read(rows * F)
         if len(raw) != rows * F:

@@ -1,0 +1,144 @@
+"""Unified telemetry: span tracing, metrics registry, heartbeats.
+
+Three pillars, one ``Obs`` hub:
+
+- :mod:`wormhole_tpu.obs.trace` — bounded thread-aware span recorder
+  emitting Chrome trace-event JSON (Perfetto-viewable);
+- :mod:`wormhole_tpu.obs.metrics` — counters/gauges/histograms behind
+  one registry with JSON-lines and Prometheus exporters;
+- :mod:`wormhole_tpu.obs.heartbeat` — rank-stamped per-host heartbeat
+  files plus launcher-side straggler detection.
+
+Everything is off by default; :func:`setup` reads the ``Config`` knobs
+(``trace_path``, ``metrics_export``, ``heartbeat_itv``,
+``straggler_factor``) and returns a hub whose methods are no-ops for
+whatever stayed disabled. Learners call ``obs.heartbeat_tick`` from
+their display cadence and ``obs.finalize`` at run end; everything else
+(Timer.scope spans, DeviceFeed stage spans, collective/checkpoint
+spans) keys off the module-global ``trace.enabled()`` fast path alone.
+
+This package must stay importable without jax — module level is stdlib
+only, jax/numpy/wormhole imports live inside functions — because
+``utils.timer`` (imported by ``wormhole_tpu.__init__``) hooks into
+:mod:`.trace`.
+
+See docs/observability.md for the knob reference and viewing guide.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import trace, metrics, heartbeat
+from .metrics import Registry, default_registry, merge_snapshots
+from .heartbeat import (HeartbeatWriter, HeartbeatMonitor,
+                        StragglerDetector, read_heartbeats)
+
+__all__ = ["trace", "metrics", "heartbeat", "Obs", "setup",
+           "Registry", "default_registry", "merge_snapshots",
+           "HeartbeatWriter", "HeartbeatMonitor", "StragglerDetector",
+           "read_heartbeats"]
+
+# launch_mp exports this so workers inherit the launcher's heartbeat
+# directory without every config file naming one
+METRICS_EXPORT_ENV = "WORMHOLE_METRICS_EXPORT"
+
+
+def _rank_path(path: str, rank: int) -> str:
+    """Per-host trace file: host 0 keeps the configured name, other
+    ranks insert ``.r<rank>`` before the extension so multi-process
+    runs don't clobber one file."""
+    if rank == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.r{rank}{ext or '.json'}"
+
+
+class Obs:
+    """Per-run telemetry hub binding the three pillars to one rank."""
+
+    def __init__(self, rank: int = 0, trace_path: str = "",
+                 metrics_export: str = "", heartbeat_itv: float = 5.0,
+                 registry: Optional[Registry] = None) -> None:
+        self.rank = rank
+        self.trace_path = _rank_path(trace_path, rank) if trace_path else ""
+        self.export_dir = metrics_export
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.hb: Optional[HeartbeatWriter] = None
+        if self.trace_path:
+            trace.enable(self.trace_path)
+        if self.export_dir:
+            try:
+                self.hb = HeartbeatWriter(self.export_dir, rank,
+                                          interval=heartbeat_itv,
+                                          registry=self.registry)
+            except OSError:
+                self.hb = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self.trace_path or self.export_dir)
+
+    def heartbeat_tick(self, step: int, num_ex: int,
+                       feed_stall: float = 0.0, **extra) -> None:
+        """Rate-limited heartbeat from the learner's display cadence;
+        free when metrics_export is unset."""
+        if self.hb is not None:
+            self.hb.beat(step, num_ex, feed_stall, **extra)
+
+    def ingest(self, timer=None, progress=None, feed_stats=None) -> None:
+        """Mirror the legacy metric surfaces into the registry."""
+        if timer is not None:
+            self.registry.from_timer(timer)
+        if progress is not None:
+            self.registry.from_progress(progress)
+        if feed_stats:
+            self.registry.ingest_feed(feed_stats)
+
+    def finalize(self, step: int = 0, num_ex: int = 0,
+                 feed_stall: float = 0.0, timer=None, progress=None,
+                 feed_stats=None, mesh=None) -> None:
+        """Run-end flush: ingest the legacy surfaces, optionally merge
+        across hosts, write the trace JSON, the Prometheus dump, and a
+        final heartbeat. Never raises into the caller."""
+        try:
+            self.ingest(timer=timer, progress=progress,
+                        feed_stats=feed_stats)
+            if mesh is not None and self.registry.names():
+                self.registry.allreduce(mesh)
+            if self.trace_path:
+                trace.flush()
+            if self.export_dir:
+                if self.hb is not None:
+                    self.hb.close(step, num_ex, feed_stall)
+                self._write_prometheus()
+        except Exception:
+            import logging
+            logging.getLogger("wormhole.obs").warning(
+                "telemetry finalize failed", exc_info=True)
+
+    def _write_prometheus(self) -> None:
+        if not self.registry.names():
+            return
+        path = os.path.join(self.export_dir, f"host{self.rank}.prom")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.registry.prometheus_text(
+                labels={"host": str(self.rank)}))
+        os.replace(tmp, path)
+
+
+def setup(cfg, rank: int = 0,
+          registry: Optional[Registry] = None) -> Obs:
+    """Build a hub from ``Config`` knobs. ``metrics_export`` falls back
+    to the launcher's exported directory (``WORMHOLE_METRICS_EXPORT``)
+    so ``launch_mp --heartbeat-dir`` works without a config change."""
+    export = getattr(cfg, "metrics_export", "") \
+        or os.environ.get(METRICS_EXPORT_ENV, "")
+    return Obs(rank=rank,
+               trace_path=getattr(cfg, "trace_path", ""),
+               metrics_export=export,
+               heartbeat_itv=getattr(cfg, "heartbeat_itv", 5.0),
+               registry=registry)

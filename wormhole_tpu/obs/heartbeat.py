@@ -1,0 +1,211 @@
+"""Per-host heartbeat files + straggler detection.
+
+The parameter-server tradition (Li et al., OSDI'14) keeps a live view of
+every worker; the reference's scheduler renders it as the merged
+progress row. Here each worker appends rank-stamped JSON-lines records
+(step, examples/s, feed-stall rate, plus the registry's metric values)
+to ``<dir>/host<rank>.hb.jsonl``; the launcher — or anything else with
+the directory — aggregates them and flags stragglers whose throughput
+falls below ``median / straggler_factor``.
+
+Files are append-only JSON lines so a tail-ing human, the launcher's
+monitor thread, and a postmortem parser all read the same thing; the
+writer is rate-limited (``heartbeat_itv``) and never raises into the
+training loop — a full disk degrades monitoring, not training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["HeartbeatWriter", "read_heartbeats", "StragglerDetector",
+           "HeartbeatMonitor"]
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"host{rank}.hb.jsonl")
+
+
+class HeartbeatWriter:
+    """Rank-stamped, rate-limited JSON-lines heartbeat appender.
+
+    ``beat(step, num_ex, feed_stall)`` computes examples/s and stall
+    rate from the deltas since the previous record and appends one line
+    at most every ``interval`` seconds (``force=True`` for run-end
+    flushes). The first call writes immediately so short runs still
+    leave a record."""
+
+    def __init__(self, directory: str, rank: int,
+                 interval: float = 5.0, registry=None) -> None:
+        self.path = heartbeat_path(directory, rank)
+        self.rank = rank
+        self.interval = max(float(interval), 0.0)
+        self.registry = registry
+        os.makedirs(directory, exist_ok=True)
+        self._last = 0.0            # monotonic of last record; 0 = never
+        self._prev_ex = 0
+        self._prev_stall = 0.0
+        self._seq = 0
+        self._dead = False
+
+    def due(self) -> bool:
+        return time.monotonic() - self._last >= self.interval
+
+    def beat(self, step: int, num_ex: int, feed_stall: float = 0.0,
+             force: bool = False, **extra) -> bool:
+        """Append one record if due; True when a line was written."""
+        if self._dead:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last < self.interval:
+            return False
+        dt = now - self._last if self._last else 0.0
+        ex_s = (num_ex - self._prev_ex) / dt if dt > 0 else 0.0
+        stall_rate = ((feed_stall - self._prev_stall) / dt
+                      if dt > 0 else 0.0)
+        rec = {"ts": round(time.time(), 3), "rank": self.rank,
+               "seq": self._seq, "step": int(step),
+               "num_ex": int(num_ex), "ex_per_sec": round(ex_s, 2),
+               "feed_stall_rate": round(stall_rate, 4)}
+        rec.update(extra)
+        if self.registry is not None:
+            rec = self.registry.record(**rec)
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            # monitoring must never kill training; stop retrying
+            self._dead = True
+            return False
+        self._last = now
+        self._prev_ex = num_ex
+        self._prev_stall = feed_stall
+        self._seq += 1
+        return True
+
+    def close(self, step: int = 0, num_ex: int = 0,
+              feed_stall: float = 0.0) -> None:
+        self.beat(step, num_ex, feed_stall, force=True, final=True)
+
+
+def read_heartbeats(directory: str) -> Dict[int, List[dict]]:
+    """Parse every host*.hb.jsonl under ``directory`` → rank -> records
+    (file order). Torn tail lines (a writer mid-append) are skipped."""
+    out: Dict[int, List[dict]] = {}
+    if not directory or not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("host") and name.endswith(".hb.jsonl")):
+            continue
+        recs = []
+        try:
+            with open(os.path.join(directory, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        recs.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        if recs:
+            out[int(recs[0].get("rank", name[4:].split(".")[0]))] = recs
+    return out
+
+
+class StragglerDetector:
+    """Flag workers whose freshest throughput sits below
+    ``median / factor`` — the heartbeat analogue of the workload pool's
+    straggler re-execution rule (both read ``Config.straggler_factor``).
+
+    Stateless check over a rank->records map so the launcher thread,
+    the scheduler, and tests all call the same logic."""
+
+    def __init__(self, factor: float = 3.0,
+                 min_workers: int = 2) -> None:
+        self.factor = max(float(factor), 1.0)
+        self.min_workers = min_workers
+
+    def check(self, by_rank: Dict[int, List[dict]]) -> List[dict]:
+        latest = {r: recs[-1] for r, recs in by_rank.items() if recs}
+        rates = {r: float(rec.get("ex_per_sec", 0.0))
+                 for r, rec in latest.items()
+                 if not rec.get("final")}
+        if len(rates) < self.min_workers:
+            return []
+        vals = sorted(rates.values())
+        median = vals[len(vals) // 2]
+        if median <= 0:
+            return []
+        floor = median / self.factor
+        return [{"rank": r, "ex_per_sec": rate, "median": median,
+                 "floor": round(floor, 2)}
+                for r, rate in sorted(rates.items()) if rate < floor]
+
+
+class HeartbeatMonitor:
+    """Launcher-side aggregator: a daemon thread that scans a heartbeat
+    directory every ``interval`` seconds and logs straggler warnings
+    (rate-limited per rank, so a persistently slow worker warns once a
+    minute instead of every scan)."""
+
+    def __init__(self, directory: str, factor: float = 3.0,
+                 interval: float = 5.0, sink=None,
+                 rewarn_after: float = 60.0) -> None:
+        self.dir = directory
+        self.detector = StragglerDetector(factor)
+        self.interval = interval
+        self.rewarn_after = rewarn_after
+        self._sink = sink
+        self._warned: Dict[int, float] = {}
+        self._stop = None
+        self._thread = None
+
+    def scan_once(self) -> List[dict]:
+        flags = self.detector.check(read_heartbeats(self.dir))
+        now = time.monotonic()
+        for f in flags:
+            last = self._warned.get(f["rank"], -1e18)
+            if now - last < self.rewarn_after:
+                continue
+            self._warned[f["rank"]] = now
+            self._emit(
+                f"[launcher] straggler: w{f['rank']} at "
+                f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
+                f"(median {f['median']:.0f}, factor "
+                f"{self.detector.factor})")
+        return flags
+
+    def _emit(self, msg: str) -> None:
+        if self._sink is not None:
+            self._sink(msg)
+        else:
+            import sys
+            print(msg, file=sys.stderr, flush=True)
+
+    def start(self) -> "HeartbeatMonitor":
+        import threading
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.scan_once()
+                except Exception:
+                    pass          # monitoring must never kill the job
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="hb-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,241 @@
+"""Span tracing: a bounded, thread-aware trace recorder.
+
+Dapper-style spans (Sigelman et al., 2010) over the hot paths this repo
+already times — DeviceFeed stages, device step dispatch/wait, collective
+boundaries, GBDT histogram kernels and chunk reads, checkpoint save/load
+— emitted as Chrome trace-event JSON that loads directly in Perfetto
+(ui.perfetto.dev) or chrome://tracing.
+
+Design constraints, in order:
+
+1. **Near-zero cost when off.** Tracing is off by default; every record
+   call starts with one module-global bool check and returns. The
+   instrumented paths (``Timer.scope``, DeviceFeed stages) are
+   per-*batch*, not per-row, so even enabled tracing is noise next to a
+   device step.
+2. **Bounded memory.** Events land in a ``deque(maxlen=ring)`` — a long
+   run keeps the freshest window instead of growing without bound
+   (the dist_monitor.h rate-limit philosophy applied to traces).
+3. **Thread attribution.** Events carry the recording thread's id and
+   the first event per thread registers its name, so the pipeline's
+   dispatcher / prep workers / transfer thread / consumer render as
+   separate Perfetto tracks and stage overlap is visible.
+
+Events are stored as tuples and formatted only at :func:`flush`; the
+record path does no dict building, no JSON, no I/O.
+
+An optional XLA profile window (:func:`xla_profile`) hangs off the same
+API so a bench phase can capture a ``jax.profiler.trace`` alongside the
+host spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+__all__ = ["enable", "disable", "enabled", "configure", "complete",
+           "span", "instant", "counter", "events", "summary", "reset",
+           "flush", "write_trace", "xla_profile"]
+
+# module-global fast path: `if not _ENABLED: return` is the entire cost
+# of every record call while tracing is off
+_ENABLED = False
+_RING: "deque" = deque(maxlen=1)
+_PATH: Optional[str] = None
+_PID = 0
+_T0 = 0.0                      # monotonic base; ts are relative to it
+_TID_NAMES: dict = {}          # tid -> thread name (first event wins)
+
+# event tuples: (ph, name, cat, ts_us, dur_us, tid, arg)
+_PH_COMPLETE = "X"
+_PH_INSTANT = "i"
+_PH_COUNTER = "C"
+
+
+def _rank() -> int:
+    """Process rank without forcing a jax import: prefer an initialized
+    jax runtime, fall back to the launcher's PROCESS_ID env, then 0."""
+    import sys
+    j = sys.modules.get("jax")
+    if j is not None:
+        try:
+            return int(j.process_index())
+        except Exception:
+            pass
+    return int(os.environ.get("PROCESS_ID", "0"))
+
+
+def configure(trace_path: str = "", ring: int = 1 << 16,
+              enabled: Optional[bool] = None) -> None:
+    """(Re)configure the global recorder. ``trace_path`` non-empty (or
+    ``enabled=True`` for a ring-only, no-file session) turns tracing on;
+    both empty/False turns it off and drops buffered events."""
+    global _ENABLED, _RING, _PATH, _PID, _T0
+    on = bool(trace_path) if enabled is None else enabled
+    _PATH = trace_path or None
+    if on:
+        _RING = deque(maxlen=max(int(ring), 16))
+        _TID_NAMES.clear()
+        _PID = _rank()
+        _T0 = time.monotonic()
+    _ENABLED = on
+    if not on:
+        _RING = deque(maxlen=1)
+        _TID_NAMES.clear()
+
+
+def enable(trace_path: str = "", ring: int = 1 << 16) -> None:
+    configure(trace_path, ring, enabled=True)
+
+
+def disable() -> None:
+    configure("", enabled=False)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _record(ph: str, name: str, cat: str, ts: float, dur: float,
+            arg=None) -> None:
+    t = threading.current_thread()
+    tid = t.ident or 0
+    if tid not in _TID_NAMES:
+        _TID_NAMES[tid] = t.name
+    # deque.append is atomic under the GIL — no lock on the record path
+    _RING.append((ph, name, cat, (ts - _T0) * 1e6, dur * 1e6, tid, arg))
+
+
+def complete(name: str, t0: float, dur: float, cat: str = "") -> None:
+    """Record a completed span: ``t0`` is the ``time.monotonic()`` start,
+    ``dur`` seconds. This is the hot-path entry point — callers that
+    already measured a duration (Timer.scope, DeviceFeed stages) hand it
+    over instead of paying a second context-manager frame."""
+    if not _ENABLED:
+        return
+    _record(_PH_COMPLETE, name, cat, t0, dur)
+
+
+@contextmanager
+def span(name: str, cat: str = "") -> Iterator[None]:
+    """``with trace.span("checkpoint:save"): ...`` — a no-op (single
+    bool check) while tracing is off."""
+    if not _ENABLED:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        _record(_PH_COMPLETE, name, cat, t0, time.monotonic() - t0)
+
+
+def instant(name: str, cat: str = "") -> None:
+    if not _ENABLED:
+        return
+    _record(_PH_INSTANT, name, cat, time.monotonic(), 0.0)
+
+
+def counter(name: str, value: float, cat: str = "") -> None:
+    """Chrome counter-track sample (rendered as a line chart)."""
+    if not _ENABLED:
+        return
+    _record(_PH_COUNTER, name, cat, time.monotonic(), 0.0, float(value))
+
+
+def events() -> list:
+    """Buffered events as trace-event dicts (the flush format)."""
+    out = []
+    for ph, name, cat, ts, dur, tid, arg in list(_RING):
+        ev = {"ph": ph, "name": name, "pid": _PID, "tid": tid,
+              "ts": round(ts, 3)}
+        if cat:
+            ev["cat"] = cat
+        if ph == _PH_COMPLETE:
+            ev["dur"] = round(dur, 3)
+        elif ph == _PH_INSTANT:
+            ev["s"] = "t"
+        elif ph == _PH_COUNTER:
+            ev["args"] = {"value": arg}
+        out.append(ev)
+    return out
+
+
+def summary() -> dict:
+    """Aggregate buffered complete-spans: name -> {count, total_s}.
+    The bench folds this per-phase view into its --out JSON."""
+    agg: dict = {}
+    for ph, name, _cat, _ts, dur, _tid, _arg in list(_RING):
+        if ph != _PH_COMPLETE:
+            continue
+        row = agg.setdefault(name, {"count": 0, "total_s": 0.0})
+        row["count"] += 1
+        row["total_s"] += dur / 1e6
+    for row in agg.values():
+        row["total_s"] = round(row["total_s"], 6)
+    return agg
+
+
+def reset() -> None:
+    _RING.clear()
+
+
+def write_trace(path: str, evs: list) -> str:
+    """Write ``evs`` (trace-event dicts, e.g. accumulated :func:`events`
+    batches) plus the recorder's thread/process metadata as a Chrome
+    trace-event JSON file (atomic tmp+replace). The bench uses this to
+    merge per-phase event batches into one viewable file."""
+    evs = list(evs)
+    for tid, tname in sorted(_TID_NAMES.items()):
+        evs.append({"ph": "M", "name": "thread_name", "pid": _PID,
+                    "tid": tid, "args": {"name": tname}})
+    evs.append({"ph": "M", "name": "process_name", "pid": _PID,
+                "args": {"name": f"wormhole-host{_PID}"}})
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Write buffered events (plus per-thread name metadata) as Chrome
+    trace-event JSON. Returns the path written, or None when tracing is
+    off / no destination is configured."""
+    dst = path or _PATH
+    if not _ENABLED or not dst:
+        return None
+    return write_trace(dst, events())
+
+
+@contextmanager
+def xla_profile(logdir: str) -> Iterator[None]:
+    """Optional ``jax.profiler.trace`` window hanging off the same API:
+    a bench phase wraps itself in this to capture an XLA profile next to
+    the host spans. Degrades to a no-op when jax (or its profiler) is
+    unavailable or the profiler refuses to start."""
+    if not logdir:
+        yield
+        return
+    try:
+        import jax
+        ctx = jax.profiler.trace(logdir)
+    except Exception:
+        yield
+        return
+    try:
+        with ctx:
+            yield
+    except Exception:
+        # a profiler that fails to start/stop must never kill the run
+        yield

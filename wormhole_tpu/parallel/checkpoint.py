@@ -23,6 +23,7 @@ from typing import Any, Optional, Tuple
 
 from flax import serialization
 
+from wormhole_tpu.obs import trace
 from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("checkpoint")
@@ -55,12 +56,14 @@ class Checkpointer:
             return 0, template
         path = self._path(ver)
         import jax
-        leaves, treedef = jax.tree.flatten(template)
-        with open(path, "rb") as f:
-            new_leaves = serialization.from_bytes(
-                {str(i): leaf for i, leaf in enumerate(leaves)}, f.read())
-        state = jax.tree.unflatten(
-            treedef, [new_leaves[str(i)] for i in range(len(leaves))])
+        with trace.span("checkpoint:load", cat="checkpoint"):
+            leaves, treedef = jax.tree.flatten(template)
+            with open(path, "rb") as f:
+                new_leaves = serialization.from_bytes(
+                    {str(i): leaf for i, leaf in enumerate(leaves)},
+                    f.read())
+            state = jax.tree.unflatten(
+                treedef, [new_leaves[str(i)] for i in range(len(leaves))])
         log.info("restart from version=%d (%s)", ver, path)
         return ver, state
 
@@ -69,16 +72,17 @@ class Checkpointer:
         if not self.dir or not self.is_writer:
             return
         import jax
-        # flatten to an index-keyed dict of host arrays: msgpack can't walk
-        # arbitrary registered dataclasses, but any pytree flattens
-        leaves = jax.tree.leaves(jax.tree.map(_to_host, state))
-        data = serialization.to_bytes(
-            {str(i): leaf for i, leaf in enumerate(leaves)})
-        path = self._path(version)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        with trace.span("checkpoint:save", cat="checkpoint"):
+            # flatten to an index-keyed dict of host arrays: msgpack can't
+            # walk arbitrary registered dataclasses, but any pytree flattens
+            leaves = jax.tree.leaves(jax.tree.map(_to_host, state))
+            data = serialization.to_bytes(
+                {str(i): leaf for i, leaf in enumerate(leaves)})
+            path = self._path(version)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
         self._gc(version)
 
     lazy_save = save  # LazyCheckPoint: same commit, no extra copy needed
@@ -163,18 +167,20 @@ class ShardCheckpointer:
                 return np.concatenate([parts[k] for k in sorted(parts)])
             return _to_host(x)
 
-        leaves = jax.tree.leaves(jax.tree.map(local_block, state))
-        data = serialization.to_bytes(
-            {str(i): leaf for i, leaf in enumerate(leaves)})
-        path = self._rank_path(version, self.rank)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
-        # all ranks must have committed before the version becomes valid
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt_v{version}")
-        open(self._marker(version), "w").close()
+        with trace.span("checkpoint:shard_save", cat="checkpoint"):
+            leaves = jax.tree.leaves(jax.tree.map(local_block, state))
+            data = serialization.to_bytes(
+                {str(i): leaf for i, leaf in enumerate(leaves)})
+            path = self._rank_path(version, self.rank)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            # all ranks must have committed before the version becomes valid
+            from jax.experimental import multihost_utils
+            with trace.span("collective:ckpt_barrier", cat="collective"):
+                multihost_utils.sync_global_devices(f"ckpt_v{version}")
+            open(self._marker(version), "w").close()
         self._gc(version)
 
     def load(self, template: Any,
@@ -184,20 +190,22 @@ class ShardCheckpointer:
         if ver == 0:
             return 0, template
         path = self._rank_path(ver, self.rank)
-        leaves, treedef = jax.tree.flatten(template)
-        with open(path, "rb") as f:
-            raw = serialization.msgpack_restore(f.read())
+        with trace.span("checkpoint:shard_load", cat="checkpoint"):
+            leaves, treedef = jax.tree.flatten(template)
+            with open(path, "rb") as f:
+                raw = serialization.msgpack_restore(f.read())
 
-        def restore_leaf(i, tmpl):
-            val = raw[str(i)]
-            if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
-                return jax.make_array_from_process_local_data(
-                    tmpl.sharding, val)
-            return val
+            def restore_leaf(i, tmpl):
+                val = raw[str(i)]
+                if isinstance(tmpl, jax.Array) \
+                        and not tmpl.is_fully_addressable:
+                    return jax.make_array_from_process_local_data(
+                        tmpl.sharding, val)
+                return val
 
-        state = jax.tree.unflatten(
-            treedef,
-            [restore_leaf(i, t) for i, t in enumerate(leaves)])
+            state = jax.tree.unflatten(
+                treedef,
+                [restore_leaf(i, t) for i, t in enumerate(leaves)])
         log.info("restart from version=%d (%s)", ver, path)
         return ver, state
 

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from wormhole_tpu.obs import trace
+
 # ---------------------------------------------------------------------------
 # in-jit collectives (use inside shard_map'ed/pjit'ed code)
 # ---------------------------------------------------------------------------
@@ -61,40 +63,45 @@ def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
     ps-lite COMPRESSING filter, async_sgd.h:144-154 / config.proto:100) —
     worthwhile for large, compressible buffers like gradient histograms;
     pure overhead for tiny ones."""
-    if jax.process_count() == 1:
-        return tree
-    from jax.experimental import multihost_utils
-    npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
-    fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+    # span recorded on the single-process fast path too: the boundary is
+    # where the sync would be, which is what a trace reader looks for
+    with trace.span(f"collective:allreduce_{op}", cat="collective"):
+        if jax.process_count() == 1:
+            return tree
+        from jax.experimental import multihost_utils
+        npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
+        fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
 
-    def reduce_leaf(x):
-        gathered = multihost_utils.process_allgather(jnp.asarray(x))
-        return np.asarray(fn(gathered, axis=0))
+        def reduce_leaf(x):
+            gathered = multihost_utils.process_allgather(jnp.asarray(x))
+            return np.asarray(fn(gathered, axis=0))
 
-    def reduce_leaf_z(x):
-        import zlib
-        x = np.asarray(x)
-        comp = zlib.compress(x.tobytes(), 1)
-        lens = np.asarray(multihost_utils.process_allgather(
-            np.int64(len(comp))))
-        buf = np.zeros(int(lens.max()), np.uint8)
-        buf[:len(comp)] = np.frombuffer(comp, np.uint8)
-        g = np.asarray(multihost_utils.process_allgather(buf))
-        parts = [np.frombuffer(zlib.decompress(
-                     g[r, :int(lens[r])].tobytes()),
-                     x.dtype).reshape(x.shape)
-                 for r in range(g.shape[0])]
-        return npfn(np.stack(parts), axis=0)
+        def reduce_leaf_z(x):
+            import zlib
+            x = np.asarray(x)
+            comp = zlib.compress(x.tobytes(), 1)
+            lens = np.asarray(multihost_utils.process_allgather(
+                np.int64(len(comp))))
+            buf = np.zeros(int(lens.max()), np.uint8)
+            buf[:len(comp)] = np.frombuffer(comp, np.uint8)
+            g = np.asarray(multihost_utils.process_allgather(buf))
+            parts = [np.frombuffer(zlib.decompress(
+                         g[r, :int(lens[r])].tobytes()),
+                         x.dtype).reshape(x.shape)
+                     for r in range(g.shape[0])]
+            return npfn(np.stack(parts), axis=0)
 
-    return jax.tree.map(reduce_leaf_z if compress else reduce_leaf, tree)
+        return jax.tree.map(reduce_leaf_z if compress else reduce_leaf,
+                            tree)
 
 
 def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0) -> Any:
     """rabit::Broadcast analogue: every process returns root's values."""
-    if jax.process_count() == 1:
-        return tree
-    from jax.experimental import multihost_utils
-    return multihost_utils.broadcast_one_to_all(
-        tree, is_source=jax.process_index() == root)
+    with trace.span("collective:broadcast", cat="collective"):
+        if jax.process_count() == 1:
+            return tree
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(
+            tree, is_source=jax.process_index() == root)
 
 

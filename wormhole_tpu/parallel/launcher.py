@@ -66,27 +66,49 @@ def launch_sim(n: int, cmd: List[str]) -> int:
     return subprocess.call(cmd, env=env)
 
 
-def _pump_lines(stream, sink, lock) -> None:
+def _pump_lines(stream, sink, lock, tag: bytes = b"") -> None:
     """Relay one child's output to ``sink`` a full line at a time.
 
     Children block-buffer when stdout is a pipe, so two ranks writing the
     shared pipe directly can flush MID-line (observed: ``num_ex=400OK`` —
     two ranks' lines spliced). Reading per-child pipes and writing whole
     lines under one lock makes the merged stream line-atomic, so tests
-    (and any log consumer) can parse it with line-anchored patterns."""
+    (and any log consumer) can parse it with line-anchored patterns.
+    ``tag`` (e.g. ``b"[w3] "``) prefixes every line so interleaved
+    multi-process output stays attributable to its rank."""
     for line in iter(stream.readline, b""):
         with lock:
+            if tag:
+                sink.write(tag)
             sink.write(line)
             sink.flush()
     stream.close()
 
 
-def launch_mp(n: int, cmd: List[str]) -> int:
+def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
+              straggler_factor: float = 3.0) -> int:
     import threading
     port = _free_port()
     procs = []
     pumps = []
     out_lock = threading.Lock()
+    monitor = None
+    if heartbeat_dir:
+        # children inherit the export dir (obs.setup falls back to this
+        # env var), the launcher watches their heartbeat files and warns
+        # on stragglers — the dist_monitor/scheduler view, file-based
+        from wormhole_tpu.obs import (METRICS_EXPORT_ENV,
+                                      HeartbeatMonitor)
+        os.makedirs(heartbeat_dir, exist_ok=True)
+
+        def _warn(msg: str) -> None:
+            with out_lock:
+                sys.stderr.write(msg + "\n")
+                sys.stderr.flush()
+
+        monitor = HeartbeatMonitor(heartbeat_dir,
+                                   factor=straggler_factor,
+                                   sink=_warn).start()
     for i in range(n):
         env = _base_env()
         env["JAX_PLATFORMS"] = "cpu"
@@ -97,13 +119,17 @@ def launch_mp(n: int, cmd: List[str]) -> int:
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["NUM_PROCESSES"] = str(n)
         env["PROCESS_ID"] = str(i)
+        if heartbeat_dir:
+            env["WORMHOLE_METRICS_EXPORT"] = heartbeat_dir
         p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE)
         procs.append(p)
+        tag = f"[w{i}] ".encode()
         for stream, sink in ((p.stdout, sys.stdout.buffer),
                              (p.stderr, sys.stderr.buffer)):
             t = threading.Thread(target=_pump_lines,
-                                 args=(stream, sink, out_lock), daemon=True)
+                                 args=(stream, sink, out_lock, tag),
+                                 daemon=True)
             t.start()
             pumps.append(t)
     import time as _time
@@ -139,6 +165,8 @@ def launch_mp(n: int, cmd: List[str]) -> int:
                 p.kill()
         for t in pumps:
             t.join(timeout=10)
+        if monitor is not None:
+            monitor.stop()
     return rc
 
 
@@ -158,6 +186,13 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--restarts", type=int, default=0,
                     help="relaunch a failed job up to K times (apps with "
                          "checkpoint_dir resume from the last version)")
+    ap.add_argument("--heartbeat-dir", default="",
+                    help="mp only: heartbeat/telemetry directory exported "
+                         "to workers (WORMHOLE_METRICS_EXPORT); the "
+                         "launcher watches it and warns on stragglers")
+    ap.add_argument("--straggler-factor", type=float, default=3.0,
+                    help="warn when a worker's ex/s falls below "
+                         "median/FACTOR (with --heartbeat-dir)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- command to launch")
     args = ap.parse_args(argv)
@@ -167,7 +202,9 @@ def main(argv: List[str] = None) -> int:
     if not cmd:
         ap.error("no command given (append: -- python app.py ...)")
     run = {"sim": lambda: launch_sim(args.num_devices, cmd),
-           "mp": lambda: launch_mp(args.num_devices, cmd),
+           "mp": lambda: launch_mp(args.num_devices, cmd,
+                                   heartbeat_dir=args.heartbeat_dir,
+                                   straggler_factor=args.straggler_factor),
            "tpu": lambda: launch_tpu(cmd)}[args.cluster]
     rc = run()
     attempt = 0

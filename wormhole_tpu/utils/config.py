@@ -85,6 +85,18 @@ class Config:
     minibatch: int = 1000
     max_data_pass: int = 10
     disp_itv: float = 1.0
+
+    # --- observability (obs/ subsystem; all off by default) ---
+    # Chrome trace-event JSON destination: non-empty turns span tracing
+    # on; the file loads in Perfetto (ui.perfetto.dev). Rank > 0 hosts
+    # write <path>.r<rank>.json. See docs/observability.md.
+    trace_path: str = ""
+    # directory for per-host heartbeat JSON-lines + run-end Prometheus
+    # dump; empty = no telemetry files. launch_mp --heartbeat-dir sets
+    # the WORMHOLE_METRICS_EXPORT fallback for its workers.
+    metrics_export: str = ""
+    # min seconds between heartbeat records (obs/heartbeat.py rate limit)
+    heartbeat_itv: float = 5.0
     epsilon: float = 0.0   # early stop when a pass improves per-example
                            # objv by less than this fraction; 0 = off
     max_objv: float = 0.0  # 0 = unset; stop if objv >= max_objv

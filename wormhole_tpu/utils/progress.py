@@ -31,6 +31,26 @@ _I_SLOTS = ["count", "num_ex", "nnz_w", "nnz_delta", "new_ex",
             "feed_batches"]
 
 
+def _check_slots() -> None:
+    """The POD layout is exactly 10+10 slots (fixed 160-byte serialize,
+    vector-add merge). A name list that outgrows its vector would
+    silently corrupt serialize/parse/merge — fail at import with the
+    offending names instead."""
+    for label, slots, cap in (("_F_SLOTS", _F_SLOTS, _NF),
+                              ("_I_SLOTS", _I_SLOTS, _NI)):
+        if len(slots) > cap:
+            raise ValueError(
+                f"Progress {label} has {len(slots)} names for {cap} "
+                f"slots; drop or widen before adding "
+                f"{slots[cap:]!r}")
+        dup = {n for n in slots if slots.count(n) > 1}
+        if dup:
+            raise ValueError(f"Progress {label}: duplicate names {sorted(dup)!r}")
+
+
+_check_slots()
+
+
 @dataclass
 class Progress:
     """Fixed-layout mergeable metric vector.
@@ -71,6 +91,13 @@ class Progress:
                          lambda s, v: s._fset("gbdt_hist", v))
     gbdt_chunk_stall = property(lambda s: s._fget("gbdt_chunk_stall"),
                                 lambda s, v: s._fset("gbdt_chunk_stall", v))
+
+    @classmethod
+    def names(cls):
+        """Slot-name introspection ``(float_names, int_names)`` — the
+        obs metrics registry mirrors the POD through this instead of
+        reaching into the private slot lists."""
+        return tuple(_F_SLOTS), tuple(_I_SLOTS)
 
     # --- POD contract ---
     def serialize(self) -> bytes:
@@ -144,10 +171,14 @@ class TimeReporter:
     most once per ``interval`` seconds (or on ``force``)."""
 
     def __init__(self, report_fn: Callable[[Progress], None],
-                 interval: float = 1.0) -> None:
+                 interval: float = 1.0, first_delay: bool = False) -> None:
         self._fn = report_fn
         self._itv = interval
-        self._last = 0.0
+        # _last=0 makes the first report fire immediately (the reference
+        # scheduler's t=0 row); first_delay=True waits a full interval
+        # first — heartbeat-style consumers don't want a startup record
+        # before any work happened
+        self._last = time.monotonic() if first_delay else 0.0
 
     def due(self) -> bool:
         """Whether the next ``report`` call would fire (callers use this

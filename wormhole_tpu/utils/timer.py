@@ -6,6 +6,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterator
 
+from wormhole_tpu.obs import trace
+
 
 def get_time() -> float:
     return time.monotonic()
@@ -27,6 +29,9 @@ class Timer:
             dt = time.monotonic() - t0
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            # every timer scope doubles as a trace span; complete() is a
+            # single bool check while tracing is off
+            trace.complete(name, t0, dt, cat="timer")
 
     def add(self, name: str, seconds: float, calls: int = 1) -> None:
         """Merge externally-measured time (e.g. from a feed thread)."""

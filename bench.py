@@ -26,9 +26,11 @@ All timings carry a forced D2H read so tunnel futures can't fake
 completion (the round-1 dispatch-rate artifact; VERDICT r2).
 
 ``--phases a,b,c`` runs a subset; ``--budget SECONDS`` (default 840)
-skips phases not yet started when the budget expires — either way the
-summary JSON always prints, instead of a harness timeout killing the
-whole run with nothing parseable on stdout (the round-5 rc=124).
+skips phases not yet started when the budget expires, and long phases
+additionally poll the deadline BETWEEN rounds/stages, returning partial
+results tagged ``budget_truncated`` — either way the summary JSON
+always prints, instead of a harness timeout killing the whole run with
+nothing parseable on stdout (the round-5 rc=124).
 ``--out FILE`` (default bench_summary.json) additionally rewrites the
 summary ATOMICALLY after every finished phase, so even a hard kill
 (SIGKILL, OOM) mid-phase leaves every already-measured number on disk. The
@@ -66,6 +68,18 @@ HBM_PEAK = {"TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0,
 MXU_PEAK_TF = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
                "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
                "TPU v6e": 918.0}
+
+# absolute perf_counter() deadline derived from --budget in main(); 0
+# disables. The phase loop's between-PHASE check alone cannot save a run
+# whose single phase overruns (the round-5 gbdt rc=124: the harness
+# killed the process mid-phase and --out never saw the later phases), so
+# long phases also poll _deadline_passed() BETWEEN rounds/stages and
+# return partial results tagged "budget_truncated".
+_DEADLINE = 0.0
+
+
+def _deadline_passed() -> bool:
+    return _DEADLINE > 0 and time.perf_counter() > _DEADLINE
 
 
 def make_sparse_batch(rng, num_buckets: int):
@@ -176,6 +190,8 @@ def bench_e2e_crec2(path: str) -> dict:
         jax.block_until_ready(app.store.slots)
         float(np.asarray(app.store.slots[0, 0]))
         windows.append((rows / (time.perf_counter() - t0), wpasses))
+        if _deadline_passed():
+            break       # best-of-fewer windows, but the summary lands
     prof = {k: round(app.timer.totals.get(k, 0.0), 3)
             for k in ("put", "dispatch", "wait")}
     from wormhole_tpu.data.crec import read_header2
@@ -292,6 +308,8 @@ def _median_window(fn, repeats=5):
     times = []
     for _ in range(repeats):
         times.append(fn())
+        if _deadline_passed():
+            break   # a median of fewer windows beats a blown budget
     return sorted(times)[len(times) // 2]
 
 
@@ -533,6 +551,8 @@ def bench_channel_ratios(path: str, stores=None) -> dict:
         t = {k: run(s, 4) / 4 for k, s in stores.items()}
         fm_r.append(t["fm"] / t["scalar"])
         wd_r.append(t["wd"] / t["scalar"])
+        if _deadline_passed():
+            break       # each pass is a complete interleaved ratio
     fm_r.sort()
     wd_r.sort()
     return {"fm_step_over_scalar": round(fm_r[len(fm_r) // 2], 2),
@@ -567,6 +587,8 @@ def bench_kmeans() -> dict:
         t0 = time.perf_counter()
         state, objv = km.one_iteration(state, batches)
         times.append(time.perf_counter() - t0)
+        if _deadline_passed():
+            break
     it_s = sorted(times)[len(times) // 2]
     return {"iter_sec": it_s, "rows_per_sec": n / it_s,
             "shape": [n, f, k]}
@@ -642,13 +664,31 @@ def bench_gbdt() -> dict:
     x = rng.standard_normal((n, F)).astype(np.float32)
     y = ((x[:, 0] + 0.5 * x[:, 3] + 0.3 * rng.standard_normal(n)) > 0
          ).astype(np.float32)
-    warm_rounds, rounds = 1, 3
+    # external-memory rounds right-sized to 2 (was 3): per-round rates
+    # are what's reported, and the external variant pays the warm-up
+    # compile at two extra shapes (chunk + ragged tail) — three timed
+    # rounds of it were the largest single block in the round-5 rc=124
+    warm_rounds, rounds, ext_rounds = 1, 3, 2
     m1 = GBDT(GBDTConfig(num_round=warm_rounds, max_depth=depth))
     m1.fit(x, y)                      # compile all level shapes
     m2 = GBDT(GBDTConfig(num_round=rounds, max_depth=depth))
     t0 = time.perf_counter()
     m2.fit(x, y)
     in_mem = (time.perf_counter() - t0) / rounds
+    out = {"round_sec_in_memory": in_mem, "rounds_per_sec": 1.0 / in_mem,
+           # per-round row rates: directly comparable across workload
+           # sizes and between the two variants
+           "rows_per_sec_in_memory": n / in_mem,
+           "hist_kernel": histmm.resolve_kernel(
+               m2.cfg.gbdt_hist_kernel, num_feat=F,
+               num_bins=m2.cfg.num_bins),
+           # counters from the PR-2 instrumentation: level-hist kernel
+           # seconds and chunk-feed consumer stalls, per timed round
+           "hist_sec_per_round_in_memory": m2.progress.gbdt_hist / rounds,
+           "chunk_rows": chunk_rows, "shape": [n, F, depth]}
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out                    # in-memory numbers still land
     # external: stream the binned cache (built once here, honestly timed
     # separately from the per-round cost like xgboost's #cache reuse)
     bins, cuts = quantile_bins(x, 256)
@@ -660,43 +700,128 @@ def bench_gbdt() -> dict:
     for lo in range(0, n, chunk_rows):
         cache.append(bins[lo:lo + chunk_rows])
     cache.close()
-    cache_build_s = time.perf_counter() - t0
+    out["cache_build_sec"] = time.perf_counter() - t0
     cache = BinnedCache.open(cache_path)
+    out["num_chunks"] = cache.num_chunks
+
+    def _cleanup():
+        try:
+            os.remove(cache_path)
+            os.rmdir(os.path.dirname(cache_path))
+        except OSError:
+            pass
+
+    if _deadline_passed():
+        _cleanup()
+        out["budget_truncated"] = True
+        return out
     # warm the chunk-shaped compiles (tree-build + predict at the chunk
     # and ragged-tail shapes) so the timed region measures rounds, not JIT
     m3w = GBDT(GBDTConfig(num_round=warm_rounds, max_depth=depth))
     m3w.cuts = cuts
     m3w._boost_external(cache, y)
-    m3 = GBDT(GBDTConfig(num_round=rounds, max_depth=depth))
+    if _deadline_passed():
+        _cleanup()
+        out["budget_truncated"] = True
+        return out
+    m3 = GBDT(GBDTConfig(num_round=ext_rounds, max_depth=depth))
     m3.cuts = cuts
     t0 = time.perf_counter()
     m3._boost_external(cache, y)
-    ext = (time.perf_counter() - t0) / rounds
-    try:
-        os.remove(cache_path)
-        os.rmdir(os.path.dirname(cache_path))
-    except OSError:
-        pass
-    return {"round_sec_in_memory": in_mem, "rounds_per_sec": 1.0 / in_mem,
-            "round_sec_external": ext,
-            "rounds_per_sec_external": 1.0 / ext,
-            # per-round row rates: directly comparable across workload
-            # sizes and between the two variants
-            "rows_per_sec_in_memory": n / in_mem,
-            "rows_per_sec_external": n / ext,
-            "external_over_in_memory": ext / in_mem,
-            "hist_kernel": histmm.resolve_kernel(
-                m3.cfg.gbdt_hist_kernel, num_feat=F,
-                num_bins=m3.cfg.num_bins),
-            # counters from the PR-2 instrumentation: level-hist kernel
-            # seconds and chunk-feed consumer stalls, per timed round
-            "hist_sec_per_round_in_memory": m2.progress.gbdt_hist / rounds,
-            "hist_sec_per_round_external": m3.progress.gbdt_hist / rounds,
-            "chunk_stall_sec_per_round":
-                m3.progress.gbdt_chunk_stall / rounds,
-            "cache_build_sec": cache_build_s,
-            "num_chunks": cache.num_chunks, "chunk_rows": chunk_rows,
-            "shape": [n, F, depth]}
+    ext = (time.perf_counter() - t0) / ext_rounds
+    _cleanup()
+    out.update({
+        "round_sec_external": ext,
+        "rounds_per_sec_external": 1.0 / ext,
+        "rows_per_sec_external": n / ext,
+        "external_over_in_memory": ext / in_mem,
+        "hist_sec_per_round_external": m3.progress.gbdt_hist / ext_rounds,
+        "chunk_stall_sec_per_round":
+            m3.progress.gbdt_chunk_stall / ext_rounds})
+    return out
+
+
+def bench_comm_filters() -> dict:
+    """The ps-lite filter chain (parallel/filters.py): wire-byte
+    reduction on a representative gradient-histogram payload, plus the
+    lossy-training parity check — L-BFGS driven through the chain's
+    error-fed 8-bit quantizer must land within 1e-3 relative of the
+    unfiltered final objective. Single-process ``allreduce_tree`` is an
+    identity, so the phase drives ``FilterChain.roundtrip`` directly:
+    the full wire codec (quantize + RLE + zlib + key-caching headers +
+    residual carry), minus only the allgather transport."""
+    import jax.numpy as jnp
+    from wormhole_tpu.data.feed import DenseBatch
+    from wormhole_tpu.models.linear import LinearObjective
+    from wormhole_tpu.parallel.filters import FilterChain
+    from wormhole_tpu.solver.lbfgs import LBFGSConfig, LBFGSSolver
+    rng = np.random.default_rng(7)
+    # payload shaped like a gbdt level histogram sync (site
+    # "gbdt/level_hist"): (grad, hess) sums over nodes x features x
+    # bins, ~90% empty cells — each node sees a data slice, so most
+    # (feature, bin) pairs never fire
+    nodes, Fh, bins = 64, 28, 256
+
+    def make_hists():
+        g = np.zeros((nodes, Fh, bins), np.float32)
+        h = np.zeros((nodes, Fh, bins), np.float32)
+        mask = rng.random(g.shape) < 0.1
+        k = int(mask.sum())
+        g[mask] = rng.standard_normal(k).astype(np.float32)
+        h[mask] = rng.random(k).astype(np.float32)
+        return g, h
+
+    chain = FilterChain(filters={"key_caching", "fixing_float",
+                                 "compressing"}, quant_bits=8)
+    hist_rounds = 10
+    err = 0.0
+    t0 = time.perf_counter()
+    for _ in range(hist_rounds):
+        tree = make_hists()
+        got = chain.roundtrip(tree, "bench/grad_hist")
+        err = max(err, max(float(np.max(np.abs(a - b)))
+                           for a, b in zip(tree, got)))
+    codec_s = time.perf_counter() - t0
+    out = {"wire_ratio": round(chain.ratio(), 2),
+           "bytes_raw": chain.stats["bytes_raw"],
+           "bytes_wire": chain.stats["bytes_wire"],
+           "quant_bits": 8, "hist_rounds": hist_rounds,
+           "hist_shape": [nodes, Fh, bins],
+           "max_abs_roundtrip_err": err,
+           "codec_mb_per_sec": round(
+               chain.stats["bytes_raw"] / 1e6 / max(codec_s, 1e-9), 1)}
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+    # parity: same data, same solver, one run unfiltered and one with
+    # every _cross_host fold routed through a fresh chain's loopback
+    # (the "linear/grad" site quantizes with error feedback; objv and
+    # line-search sites reduce exact, so Armijo sees true losses)
+    n2, F2, nnz2, mb2 = 8_192, 4_096, 32, 4_096
+    batches = []
+    for i in range(n2 // mb2):
+        cols = rng.integers(0, F2, size=(mb2, nnz2)).astype(np.int32)
+        vals = rng.random((mb2, nnz2), np.float32)
+        labels = (rng.random(mb2) < 0.5).astype(np.float32)
+        batches.append(DenseBatch(
+            cols=cols, vals=vals, labels=labels,
+            row_mask=np.ones(mb2, np.float32)))
+    w0 = jnp.zeros(F2, jnp.float32)
+    scfg = LBFGSConfig(memory=10, max_iter=12)
+    obj_a = LinearObjective(batches, F2, "logit", reg_l2=1.0)
+    fa = float(obj_a.objv(LBFGSSolver(scfg, obj_a).run(w0).w))
+    obj_b = LinearObjective(batches, F2, "logit", reg_l2=1.0)
+    grad_chain = FilterChain(filters={"key_caching", "fixing_float",
+                                      "compressing"}, quant_bits=8,
+                             min_bytes=0)
+    obj_b._cross_host = lambda tree, site: grad_chain.roundtrip(tree, site)
+    fb = float(obj_b.objv(LBFGSSolver(scfg, obj_b).run(w0).w))
+    rel = abs(fb - fa) / max(abs(fa), 1e-12)
+    out.update({"unfiltered_final_objv": fa, "filtered_final_objv": fb,
+                "objv_rel_diff": rel,
+                "objv_within_1e-3": bool(rel < 1e-3),
+                "grad_wire_ratio": round(grad_chain.ratio(), 2)})
+    return out
 
 
 def bench_scale_curve(workdir: str, rng) -> list:
@@ -713,6 +838,8 @@ def bench_scale_curve(workdir: str, rng) -> list:
     out = []
     rows = 98_304 * 2
     for nb_log in (22, 24, 26):
+        if out and _deadline_passed():
+            break       # partial curve: each entry stands alone
         nb = 1 << nb_log
         path = os.path.join(workdir, f"scale_{nb_log}.crec2")
         with CRec2Writer(path, nnz=CRITEO_NNZ, nb=nb) as w:
@@ -768,7 +895,7 @@ def bench_scale_curve(workdir: str, rng) -> list:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "device_fm", "device_wide_deep", "channel_ratios",
           "device_sparse", "device_dense_apply", "scale_curve",
-          "kmeans", "lbfgs", "gbdt"]
+          "comm_filters", "kmeans", "lbfgs", "gbdt"]
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
 _CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
@@ -853,6 +980,10 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
             results["channel_ratios"]
     if "scale_curve" in results:
         extra["scale_curve_tile_step"] = results["scale_curve"]
+    if "comm_filters" in results:
+        extra["comm_filters"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in results["comm_filters"].items()}
     for name, key in (("kmeans", "kmeans_mnist784"),
                       ("lbfgs", "lbfgs_rcv1"),
                       ("gbdt", "gbdt_higgs200k")):
@@ -923,6 +1054,12 @@ def main(argv=None) -> None:
                     help="also write the accumulated spans as Chrome "
                          "trace-event JSON (view at ui.perfetto.dev)")
     args = ap.parse_args(argv)
+    if args.budget > 0:
+        # in-phase truncation (between rounds/stages) shares the same
+        # clock as the phase-skip check below, minus a margin so a
+        # truncated phase still has time to wrap up and checkpoint
+        global _DEADLINE
+        _DEADLINE = time.perf_counter() + args.budget * 0.92
     sel = [p.strip() for p in args.phases.split(",") if p.strip()] \
         if args.phases else list(PHASES)
     unknown = sorted(set(sel) - set(PHASES))
@@ -965,6 +1102,7 @@ def main(argv=None) -> None:
         "device_sparse": bench_device_sparse,
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
+        "comm_filters": bench_comm_filters,
         "kmeans": bench_kmeans,
         "lbfgs": bench_lbfgs,
         "gbdt": bench_gbdt,

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Lint: no direct ``multihost_utils`` use outside wormhole_tpu/parallel/.
+
+Every host-level DCN hop must go through parallel/collectives.py
+(``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree`` /
+``host_local_to_global``): that is where the ps-lite filter chain
+(parallel/filters.py — KEY_CACHING / FIXING_FLOAT / COMPRESSING) and the
+wire-byte accounting (``comm/bytes_raw`` etc.) live. A call site that
+imports ``jax.experimental.multihost_utils`` directly bypasses both —
+its payload ships unfiltered and its bytes vanish from the comm
+counters — so this lint fails the build until the site is rewritten
+against the wrappers or consciously allowlisted with a reason.
+
+The check is textual (comments stripped), not an AST walk: it must
+catch the module name inside lazy function-level imports and strings
+being exec'd too, and false positives are resolved by the allowlist
+anyway.
+
+Run from the repo root (or pass ``--root``)::
+
+    python scripts/lint_collectives.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+# Audited files outside parallel/ that legitimately reference
+# multihost_utils. Every entry carries the reason. Deliberately EMPTY:
+# the PR that introduced this lint rewrote every call site against the
+# parallel/ wrappers, and new entries should be rare and argued.
+ALLOWLIST: dict = {}
+
+_PAT = re.compile(r"\bmultihost_utils\b")
+
+
+def _strip_comments(text: str) -> str:
+    """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive about
+    `#` inside string literals — good enough for a lint whose false
+    positives land in a human-reviewed allowlist."""
+    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
+
+
+def scan_file(path: str) -> list:
+    """Return 1-based line numbers of multihost_utils references."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        text = _strip_comments(f.read())
+    return [text.count("\n", 0, m.start()) + 1
+            for m in _PAT.finditer(text)]
+
+
+def run(root: str) -> int:
+    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
+    pkg = os.path.join(root, "wormhole_tpu")
+    if not os.path.isdir(pkg):
+        print(f"lint_collectives: no wormhole_tpu package under {root!r}",
+              file=sys.stderr)
+        return 2
+    violations = []
+    seen_allowed = set()
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel.startswith("wormhole_tpu/parallel/"):
+                continue  # parallel/ owns the raw transport
+            lines = scan_file(path)
+            if not lines:
+                continue
+            if rel in ALLOWLIST:
+                seen_allowed.add(rel)
+            else:
+                violations.extend(f"{rel}:{ln}" for ln in lines)
+    for rel in sorted(set(ALLOWLIST) - seen_allowed):
+        # stale entries are a warning, not a failure: deleting the last
+        # reference from an audited file should not break the build
+        print(f"lint_collectives: allowlist entry {rel} has no "
+              f"multihost_utils references (stale?)", file=sys.stderr)
+    if violations:
+        print("lint_collectives: direct multihost_utils use outside "
+              "wormhole_tpu/parallel/:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        print("route the call through parallel/collectives.py "
+              "(allreduce_tree / allgather_tree / broadcast_tree / "
+              "host_local_to_global) so it rides the filter chain and "
+              "the comm byte counters, or add the file to ALLOWLIST in "
+              "scripts/lint_collectives.py with a reason",
+              file=sys.stderr)
+        return 1
+    print(f"lint_collectives: OK ({len(seen_allowed)} allowlisted files)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=".",
+                    help="repo root containing wormhole_tpu/ "
+                         "(default: cwd)")
+    args = ap.parse_args(argv)
+    return run(args.root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

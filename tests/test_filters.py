@@ -1,0 +1,373 @@
+"""ps-lite filter chain (wormhole_tpu/parallel/filters.py): quantizer
+properties, wire-format roundtrips across the dtype matrix, and
+multi-"host" parity — lossless filters must be bit-exact, FIXING_FLOAT
+must stay within the error-feedback tolerance over repeated rounds.
+
+Multi-host exchanges are simulated with one FilterChain per fake host
+(each chain owns its residuals and key caches, exactly the per-process
+state of a real run) wired through encode_leaf/decode_leaf by hand; no
+multi-process launch needed."""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.parallel.filters import (
+    DEFAULT_LOSSY_SITES, FILTER_NAMES, FilterChain, dequantize_np,
+    quantize_dequantize, quantize_np, rle_decode, rle_encode)
+
+LOSSY_SITE = "bench/grad_hist"      # in DEFAULT_LOSSY_SITES
+EXACT_SITE = "obs/registry"         # never in the lossy allowlist
+
+
+def full_chain(**kw):
+    kw.setdefault("filters", set(FILTER_NAMES))
+    kw.setdefault("min_bytes", 0)
+    return FilterChain(**kw)
+
+
+# ---------------------------------------------------------------------------
+# quantizer properties
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [2, 4, 8, 12, 16])
+def test_quantizer_error_bound(bits):
+    # max roundtrip error <= half a quantization step = scale / (2*levels)
+    # ... but the np round() ties plus the f64->f32 cast allow a hair
+    # more; scale/levels is the documented (and ample) bound
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(4096).astype(np.float32) * 3.0
+    codes, scale = quantize_np(x, bits)
+    back = dequantize_np(codes, scale, bits, np.float32)
+    levels = 2 ** (bits - 1) - 1
+    assert float(np.max(np.abs(back - x))) <= scale / levels
+
+
+def test_quantizer_idempotent_on_grid():
+    # values already on the quantization grid survive a second pass
+    # exactly: round() maps each grid point to itself
+    x = np.linspace(-2.0, 2.0, 257).astype(np.float32)
+    codes, scale = quantize_np(x, 8)
+    once = dequantize_np(codes, scale, 8, np.float32)
+    codes2, scale2 = quantize_np(once, 8)
+    twice = dequantize_np(codes2, scale2, 8, np.float32)
+    np.testing.assert_array_equal(once, twice)
+
+
+def test_quantizer_preserves_zero():
+    x = np.zeros(512, np.float32)
+    x[7] = 1.0  # non-trivial scale; every true zero must stay zero
+    codes, scale = quantize_np(x, 8)
+    back = dequantize_np(codes, scale, 8, np.float32)
+    assert back[7] != 0.0
+    mask = np.ones(512, bool)
+    mask[7] = False
+    assert not back[mask].any()
+
+
+def test_jit_and_np_quantizers_agree():
+    # store.py's in-jit transform and the wire codec must be the SAME
+    # quantizer (the PR deleted store's private copy)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal(1024).astype(np.float32)
+    jit_rt = np.asarray(quantize_dequantize(x, 8))
+    np_rt = dequantize_np(*quantize_np(x, 8), 8, np.float32)
+    np.testing.assert_allclose(jit_rt, np_rt, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# zero-RLE
+# ---------------------------------------------------------------------------
+
+def test_rle_roundtrip_sparse():
+    rng = np.random.default_rng(2)
+    a = np.zeros(8192, np.uint8)
+    idx = rng.choice(8192, 200, replace=False)
+    a[idx] = rng.integers(1, 255, 200)
+    raw = a.tobytes()
+    enc = rle_encode(raw)
+    assert enc is not None and len(enc) < len(raw)
+    assert rle_decode(enc) == raw
+
+
+def test_rle_declines_dense():
+    rng = np.random.default_rng(3)
+    raw = rng.integers(1, 255, 4096, dtype=np.uint8).tobytes()
+    assert rle_encode(raw) is None  # would not shrink
+
+
+@pytest.mark.parametrize("n", [64, 65, 71, 4096, 4099])
+def test_rle_ragged_lengths(n):
+    # lengths off the 8-byte word grid: the padded trailing zero run
+    # must decode back to EXACTLY n bytes
+    raw = b"\x00" * (n - 5) + b"abcde"
+    enc = rle_encode(raw)
+    assert enc is not None
+    assert rle_decode(enc) == raw
+    raw2 = b"xy" + b"\x00" * (n - 2)
+    enc2 = rle_encode(raw2)
+    assert enc2 is not None
+    assert rle_decode(enc2) == raw2
+
+
+# ---------------------------------------------------------------------------
+# wire-format roundtrip: dtype matrix (the satellite-3 frombuffer fix)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float16", "float32", "float64",
+                                   "int8", "int32", "int64", "uint8",
+                                   "uint32"])
+def test_roundtrip_dtype_matrix(dtype):
+    rng = np.random.default_rng(4)
+    chain = full_chain()
+    x = (rng.standard_normal((33, 17)) * 100).astype(dtype)
+    # EXACT site: even floats must come back bit-identical
+    got = chain.decode_leaf(EXACT_SITE, 0,
+                            chain.encode_leaf(EXACT_SITE, 0, x))
+    assert got.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(got, x)
+
+
+def test_roundtrip_noncontiguous_and_scalar():
+    chain = full_chain()
+    x = np.asfortranarray(np.arange(96, dtype=np.float32).reshape(8, 12))
+    got = chain.decode_leaf(EXACT_SITE, 0,
+                            chain.encode_leaf(EXACT_SITE, 0, x))
+    np.testing.assert_array_equal(got, x)
+    # 0-d leaves (an objv riding in a (objv, grad) tuple) must keep
+    # their shape — ascontiguousarray would promote them to (1,)
+    s = np.float32(3.25)
+    got = chain.decode_leaf(LOSSY_SITE, 1,
+                            chain.encode_leaf(LOSSY_SITE, 1, s))
+    assert got.shape == () and float(got) == 3.25
+
+
+def test_decode_respects_exact_byte_length():
+    # the transport pads every rank's buffer to the max length; decode
+    # must never read past the sender's true length. Simulate by
+    # appending garbage pad — the decode output must not change.
+    chain = full_chain()
+    x = np.arange(300, dtype=np.int64)
+    buf = chain.encode_leaf(EXACT_SITE, 0, x)
+    padded = buf + b"\xab" * 64
+    # decode of the UNSLICED padded buffer still honours payload_len
+    got = chain.decode_leaf(EXACT_SITE, 0, padded)
+    np.testing.assert_array_equal(got, x)
+
+
+# ---------------------------------------------------------------------------
+# filter parity across simulated hosts
+# ---------------------------------------------------------------------------
+
+def _exchange(chains, tree_per_host, site, op="sum"):
+    """One collective round: every host encodes its leaves, every host
+    decodes every peer's buffers and folds — the allreduce_tree wire
+    path without the transport."""
+    import jax
+    world = len(chains)
+    flat = [jax.tree.flatten(t) for t in tree_per_host]
+    treedef = flat[0][1]
+    nleaf = len(flat[0][0])
+    bufs = [[chains[h].encode_leaf(site, i, flat[h][0][i], op)
+             for i in range(nleaf)] for h in range(world)]
+    out = []
+    for h in range(world):
+        leaves = []
+        for i in range(nleaf):
+            parts = [chains[h].decode_leaf(site, i, bufs[p][i])
+                     for p in range(world)]
+            leaves.append(np.sum(np.stack(parts), axis=0))
+        out.append(jax.tree.unflatten(treedef, leaves))
+    return out
+
+
+def test_keycaching_compressing_bit_exact_multiround():
+    rng = np.random.default_rng(5)
+    world, rounds = 3, 6
+    chains = [FilterChain(filters={"key_caching", "compressing"},
+                          min_bytes=0) for _ in range(world)]
+    for r in range(rounds):
+        trees = [(rng.standard_normal(512).astype(np.float32),
+                  rng.integers(0, 9, 128)) for _ in range(world)]
+        got = _exchange(chains, trees, "t/exact")
+        want0 = np.sum(np.stack([t[0] for t in trees]), axis=0)
+        want1 = np.sum(np.stack([t[1] for t in trees]), axis=0)
+        for g in got:
+            np.testing.assert_array_equal(g[0], want0)  # bit-exact
+            np.testing.assert_array_equal(g[1], want1)
+    # round 2+ must have shipped digest headers, not full signatures
+    for c in chains:
+        assert c.ratio() > 1.0
+
+
+def test_fixing_float_error_feedback_multiround():
+    # cumulative-sum tolerance: with error feedback, the TOTAL of many
+    # lossy rounds tracks the exact total to ~one quantization step,
+    # instead of accumulating sqrt(rounds) * step noise
+    rng = np.random.default_rng(6)
+    world, rounds, n = 2, 50, 256
+    chains = [full_chain() for _ in range(world)]
+    acc_lossy = np.zeros(n)
+    acc_exact = np.zeros(n)
+    max_scale = 0.0
+    for r in range(rounds):
+        trees = [rng.standard_normal(n).astype(np.float32)
+                 for _ in range(world)]
+        got = _exchange(chains, trees, LOSSY_SITE)
+        exact = np.sum(np.stack(trees), axis=0)
+        max_scale = max(max_scale,
+                        max(float(np.max(np.abs(t))) for t in trees))
+        acc_lossy += got[0]
+        acc_exact += exact
+        # all hosts decode the same bytes -> identical results
+        np.testing.assert_array_equal(got[0], got[1])
+    step = world * max_scale / 127.0  # 8-bit levels
+    cum_err = float(np.max(np.abs(acc_lossy - acc_exact)))
+    assert cum_err < 4 * step, (cum_err, step)
+
+
+def test_lossy_gated_by_site_and_op():
+    chain = full_chain()
+    x = np.linspace(-1, 1, 256).astype(np.float32)
+    # exact site: bit-exact even with fixing_float in the chain
+    got = chain.decode_leaf(EXACT_SITE, 0,
+                            chain.encode_leaf(EXACT_SITE, 0, x))
+    np.testing.assert_array_equal(got, x)
+    # lossy site but op != sum: still exact (a max/min fold of lossy
+    # values would not telescope)
+    got = chain.decode_leaf(LOSSY_SITE, 0,
+                            chain.encode_leaf(LOSSY_SITE, 0, x, op="max"))
+    np.testing.assert_array_equal(got, x)
+    # lossy site + sum: quantized, within one step
+    got = chain.decode_leaf(LOSSY_SITE, 1,
+                            chain.encode_leaf(LOSSY_SITE, 1, x, op="sum"))
+    assert float(np.max(np.abs(got - x))) <= 1.0 / 127.0 + 1e-6
+    assert not np.array_equal(got, x)
+
+
+def test_small_and_int_leaves_never_quantize():
+    chain = full_chain()
+    small = np.linspace(0, 1, 63).astype(np.float32)  # < _QUANT_MIN_ELEMS
+    got = chain.decode_leaf(LOSSY_SITE, 0,
+                            chain.encode_leaf(LOSSY_SITE, 0, small))
+    np.testing.assert_array_equal(got, small)
+    ints = np.arange(4096, dtype=np.int32)
+    got = chain.decode_leaf(LOSSY_SITE, 1,
+                            chain.encode_leaf(LOSSY_SITE, 1, ints))
+    np.testing.assert_array_equal(got, ints)
+
+
+def test_residual_resets_on_shape_change():
+    chain = full_chain()
+    a = np.ones(256, np.float32)
+    chain.decode_leaf(LOSSY_SITE, 0, chain.encode_leaf(LOSSY_SITE, 0, a))
+    b = np.ones(512, np.float32)  # same site+leaf, new shape
+    got = chain.decode_leaf(LOSSY_SITE, 0,
+                            chain.encode_leaf(LOSSY_SITE, 0, b))
+    assert got.shape == (512,)
+    np.testing.assert_allclose(got, b, atol=1.0 / 127.0 + 1e-6)
+
+
+def test_keycaching_digest_miss_raises():
+    send = FilterChain(filters={"key_caching"})
+    recv = FilterChain(filters={"key_caching"})
+    x = np.arange(32, dtype=np.float32)
+    recv.decode_leaf("t/s", 0, send.encode_leaf("t/s", 0, x))
+    cached = send.encode_leaf("t/s", 0, x)  # digest-only header now
+    fresh = FilterChain(filters={"key_caching"})  # never saw the sig
+    with pytest.raises(ValueError, match="digest"):
+        fresh.decode_leaf("t/s", 0, cached)
+    # the receiver that DID learn the sig decodes the cached form fine
+    np.testing.assert_array_equal(recv.decode_leaf("t/s", 0, cached), x)
+
+
+def test_truncated_payload_raises():
+    chain = full_chain()
+    buf = chain.encode_leaf(EXACT_SITE, 0, np.arange(128, dtype=np.int32))
+    with pytest.raises(ValueError, match="truncated"):
+        chain.decode_leaf(EXACT_SITE, 0, buf[:-3])
+
+
+# ---------------------------------------------------------------------------
+# chain plumbing: identity, config, accounting, roundtrip loopback
+# ---------------------------------------------------------------------------
+
+def test_disabled_chain_is_identity():
+    chain = FilterChain()  # no filters
+    tree = {"a": np.arange(8), "b": (np.ones(3), 2.0)}
+    assert not chain.active_for("x/y")
+    assert chain.roundtrip(tree, "x/y") is tree  # same object, no work
+    assert chain.stats == {"bytes_raw": 0, "bytes_wire": 0}
+
+
+def test_unknown_filter_and_bad_bits_rejected():
+    with pytest.raises(ValueError, match="unknown comm filters"):
+        FilterChain(filters={"keycache"})
+    with pytest.raises(ValueError, match="comm_quant_bits"):
+        FilterChain(filters={"fixing_float"}, quant_bits=1)
+    with pytest.raises(ValueError, match="comm_quant_bits"):
+        FilterChain(filters={"fixing_float"}, quant_bits=17)
+
+
+def test_install_from_config():
+    from wormhole_tpu.parallel.filters import (get_chain,
+                                               install_from_config,
+                                               set_chain)
+    from wormhole_tpu.utils.config import Config
+    prev = set_chain(None)
+    try:
+        cfg = Config(comm_filters="key_caching, compressing",
+                     comm_quant_bits=6, comm_compress_min_bytes=99)
+        chain = install_from_config(cfg)
+        assert get_chain() is chain
+        assert chain.filters == {"key_caching", "compressing"}
+        assert chain.quant_bits == 6 and chain.min_bytes == 99
+        # empty knob uninstalls — the off-by-default contract
+        assert install_from_config(Config()) is None
+        assert get_chain() is None
+    finally:
+        set_chain(prev)
+
+
+def test_wire_ratio_on_sparse_histograms():
+    # the headline claim at test scale: quant8 + RLE + zlib on a
+    # mostly-zero float histogram beats 4x easily
+    rng = np.random.default_rng(8)
+    chain = full_chain()
+    h = np.zeros((64, 256), np.float32)
+    idx = rng.random(h.shape) < 0.1
+    h[idx] = rng.standard_normal(int(idx.sum()))
+    chain.roundtrip(h, LOSSY_SITE)
+    assert chain.ratio() > 4.0
+    assert chain.stats["bytes_raw"] == h.nbytes
+
+
+def test_registry_counters_account_bytes():
+    from wormhole_tpu.obs.metrics import default_registry
+    reg = default_registry()
+    base_raw = reg.counter("comm/bytes_raw").value
+    base_wire = reg.counter("comm/bytes_wire").value
+    chain = full_chain()
+    x = np.zeros(2048, np.float32)
+    chain.roundtrip(x, EXACT_SITE)
+    d_raw = reg.counter("comm/bytes_raw").value - base_raw
+    d_wire = reg.counter("comm/bytes_wire").value - base_wire
+    assert d_raw == x.nbytes
+    assert 0 < d_wire < d_raw
+    assert reg.counter("comm/filter_saved").value >= d_raw - d_wire
+
+
+def test_trace_span_args_recorded():
+    # collectives attach payload sizes to their spans via args; the
+    # trace ring must surface them in events()
+    from wormhole_tpu.obs import trace
+    trace.enable("", ring=256)
+    try:
+        args = {"site": "t/span"}
+        with trace.span("collective:test", cat="comm", args=args):
+            args["bytes_wire"] = 123
+        evs = [e for e in trace.events()
+               if e.get("name") == "collective:test"]
+        assert evs and evs[-1]["args"] == {"site": "t/span",
+                                           "bytes_wire": 123}
+    finally:
+        trace.disable()

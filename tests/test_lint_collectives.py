@@ -1,0 +1,58 @@
+"""The collectives lint (scripts/lint_collectives.py) guards the filter
+chain: every host DCN hop must enter through parallel/collectives.py so
+it rides the ps-lite filters and the comm byte counters. Direct
+`multihost_utils` use outside wormhole_tpu/parallel/ fails the build."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "lint_collectives.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True)
+
+
+def test_repo_passes_lint():
+    r = _run("--root", REPO)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_learners_models_not_allowlisted():
+    # the point of the filters PR: async_sgd/gbdt now go through the
+    # parallel/ wrappers, and the allowlist starts (and should stay) empty
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_collectives
+    finally:
+        sys.path.pop(0)
+    assert lint_collectives.ALLOWLIST == {}
+    for rel in ("learners/async_sgd.py", "models/gbdt.py"):
+        assert lint_collectives.scan_file(
+            os.path.join(REPO, "wormhole_tpu", *rel.split("/"))) == []
+
+
+def test_synthetic_violation_caught(tmp_path):
+    pkg = tmp_path / "wormhole_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "def f(x):\n"
+        "    # a comment naming multihost_utils must NOT trip the lint\n"
+        "    from jax.experimental import multihost_utils\n"
+        "    return multihost_utils.process_allgather(x)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "wormhole_tpu/bad.py:3" in r.stderr
+
+
+def test_parallel_dir_is_exempt(tmp_path):
+    pkg = tmp_path / "wormhole_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "transport.py").write_text(
+        "from jax.experimental import multihost_utils\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0

@@ -80,7 +80,8 @@ def load_dense_batches(uri: str, rt: MeshRuntime, *,
     local_max = max((b.max_index() for b in blocks), default=0)
     if not num_features:
         num_features = int(allreduce_tree(np.int64(local_max + 1),
-                                          rt.mesh, "max"))
+                                          rt.mesh, "max",
+                                          site="loader/num_features"))
     elif local_max >= num_features:
         raise ValueError(f"feature id {local_max} >= num_features "
                          f"{num_features}")

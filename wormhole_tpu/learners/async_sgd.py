@@ -126,6 +126,11 @@ class AsyncSGD:
         # metrics_export turns heartbeat/Prometheus files on; both off
         # (the default) leaves every instrumented path at one bool check
         self.obs = obs.setup(cfg, self.rt.rank)
+        # communication filter chain (parallel/filters.py): cfg-driven,
+        # process-global so every collective below — metric windows,
+        # pooled AUC, model broadcast — rides the same chain
+        from wormhole_tpu.parallel import filters as comm_filters
+        comm_filters.install_from_config(cfg)
 
     # -- worker data path ---------------------------------------------------
 
@@ -245,7 +250,8 @@ class AsyncSGD:
             # the psum'd metric buffer flying home — the sparse-path
             # collective boundary, same span name as the crec harvest
             with obs.trace.span("collective:metrics_window",
-                                cat="collective"):
+                                cat="collective",
+                                args={"site": "async_sgd/metrics_window"}):
                 metrics = jax.block_until_ready(metrics)
             objv, num_ex, a, acc = (float(np.asarray(m))
                                     for m in metrics[:4])
@@ -417,7 +423,8 @@ class AsyncSGD:
             # the fetched accumulator is the psum'd metric buffer — this
             # resolve IS the collective boundary on the device step path
             with obs.trace.span("collective:metrics_window",
-                                cat="collective"):
+                                cat="collective",
+                                args={"site": "async_sgd/metrics_window"}):
                 row = np.asarray(ticket)
             local.objv += float(row[0])
             local.num_ex += int(row[1])
@@ -912,16 +919,15 @@ class AsyncSGD:
 
     def _global_batch(self, batch):
         """Assemble per-host batches into one data-axis-sharded batch."""
-        from jax.experimental import multihost_utils
         from jax.sharding import PartitionSpec as P
         from wormhole_tpu.data.feed import SparseBatch
+        from wormhole_tpu.parallel.collectives import host_local_to_global
         kpad = self.cfg.key_pad
         batch = SparseBatch(
             cols=batch.cols + np.int32(self._slot * kpad),
             vals=batch.vals, labels=batch.labels, row_mask=batch.row_mask,
             uniq_keys=batch.uniq_keys, key_mask=batch.key_mask)
-        return multihost_utils.host_local_array_to_global_array(
-            batch, self.rt.mesh, P(DATA_AXIS))
+        return host_local_to_global(batch, self.rt.mesh, P(DATA_AXIS))
 
     def _empty_local_batch(self):
         from wormhole_tpu.data.feed import SparseBatch
@@ -939,8 +945,8 @@ class AsyncSGD:
         """One synchronized pass over ``pattern`` with the replicated
         dynamic pool. The returned Progress is GLOBAL — every metric comes
         out of the global step, so all hosts compute identical values."""
-        from jax.experimental import multihost_utils
-        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.parallel.collectives import (allgather_tree,
+                                                       allreduce_tree)
         cfg = self.cfg
         world = self.rt.world
         # rounds-based straggler re-execution: deterministic across the
@@ -984,8 +990,9 @@ class AsyncSGD:
             need = my_it is None
             # one exchange per global step:
             # (finished part, need, drained, blocks contributed)
-            status = multihost_utils.process_allgather(
-                rr.status_row(finished_id, need, drained))
+            status = allgather_tree(
+                rr.status_row(finished_id, need, drained),
+                self.rt.mesh, site="async_sgd/status")
             finished_id = -1
             rr.advance(status)
             # identical pool transitions on every replica, in rank order
@@ -1031,7 +1038,8 @@ class AsyncSGD:
                     else:
                         rr.produced(1)
             have = int(allreduce_tree(np.int64(blk is not None),
-                                      self.rt.mesh, "sum"))
+                                      self.rt.mesh, "sum",
+                                      site="async_sgd/have"))
             if have == 0:
                 # global decision: status and the pool (hence any_claimed)
                 # are identical on every replica. A pending finished_id
@@ -1073,7 +1081,6 @@ class AsyncSGD:
         (model axis range-shards the folded bucket table). A host with no
         block this round contributes all-PAD blocks, which vanish from
         every product."""
-        from jax.experimental import multihost_utils
         from jax.sharding import PartitionSpec as P
         from wormhole_tpu.data.crec import (PackedFeed, read_header,
                                             read_header2)
@@ -1165,15 +1172,17 @@ class AsyncSGD:
                     group.append(item[0])
                     rr.produced(1)
 
-        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.parallel.collectives import (
+            allgather_tree, allreduce_tree, host_local_to_global)
         while True:
             group: list = []
             collect(group)
             # drained hosts stay needy: a straggler re-issue must find a
             # claimant (drained flips back off when the pool hands work)
             need = my_it is None
-            status = multihost_utils.process_allgather(
-                rr.status_row(finished_id, need, drained))
+            status = allgather_tree(
+                rr.status_row(finished_id, need, drained),
+                self.rt.mesh, site="async_sgd/status")
             finished_id = -1
             rr.advance(status)
             for r in range(world):
@@ -1206,7 +1215,7 @@ class AsyncSGD:
                     my_it = feed_iter(my_wl, my_skip)
                     collect(group)   # contribute in the claim round too
             have = int(allreduce_tree(np.int64(len(group)), self.rt.mesh,
-                                      "sum"))
+                                      "sum", site="async_sgd/have"))
             if have == 0:
                 # global decision: status and the pool (hence any_claimed)
                 # are identical on every replica
@@ -1222,8 +1231,8 @@ class AsyncSGD:
                           for k in ("pw", "labels", "ovf_b", "ovf_r")}
             else:
                 blocks = np.stack(group)
-            gblocks = multihost_utils.host_local_array_to_global_array(
-                blocks, self.rt.mesh, P(DATA_AXIS))
+            gblocks = host_local_to_global(blocks, self.rt.mesh,
+                                           P(DATA_AXIS))
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
                     if fmt == "crec2":
@@ -1294,7 +1303,8 @@ class AsyncSGD:
             # ranks must agree on the resume point even when the
             # checkpoint dir is not shared: the slowest view wins
             ver = int(allreduce_tree(np.int64(ckpt.latest_version()),
-                                     self.rt.mesh, "min"))
+                                     self.rt.mesh, "min",
+                                     site="async_sgd/ckpt_ver"))
             if ver:
                 _, state = ckpt.load(self.store.state_pytree(),
                                      version=ver)
@@ -1379,11 +1389,11 @@ class AsyncSGD:
             np.add.at(pos, b, (labels > 0.5) * weights)
             np.add.at(neg, b, (labels <= 0.5) * weights)
         z = self.cfg.msg_compression
-        pos = np.asarray(allreduce_tree(pos, self.rt.mesh, "sum",
-                                        compress=z))
-        neg = np.asarray(allreduce_tree(neg, self.rt.mesh, "sum",
-                                        compress=z))
-        return auc_from_hist(pos, neg)
+        # one tree, one exchange — and each leaf keeps its own
+        # error-feedback residual slot at the site
+        pos, neg = allreduce_tree((pos, neg), self.rt.mesh, "sum",
+                                  compress=z, site="async_sgd/auc_hist")
+        return auc_from_hist(np.asarray(pos), np.asarray(neg))
 
     def _write_preds(self, pooled: list, out_path: str) -> None:
         from wormhole_tpu.data.stream import open_stream

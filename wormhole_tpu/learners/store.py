@@ -135,13 +135,9 @@ def masked_push(handle: Handle, s32, grad, t, tau, exact_dense: bool):
     return new
 
 
-def quantize_dequantize(g: jax.Array, bits: int) -> jax.Array:
-    """Symmetric fixed-point round-trip (FIXING_FLOAT filter semantics:
-    lossy fixed-byte compression of values in transit)."""
-    scale = jnp.max(jnp.abs(g)) + 1e-30
-    levels = float(2 ** (bits - 1) - 1)
-    q = jnp.round(g / scale * levels)
-    return q * (scale / levels)
+# the FIXING_FLOAT quantizer lives in parallel/filters.py (one
+# implementation for the in-jit fixed_bytes path here AND the wire
+# codec); _build_step imports quantize_dequantize from there.
 
 
 # -- shared mesh-step machinery (used by the linear, FM and wide&deep
@@ -305,6 +301,7 @@ class ShardedStore(TableCheckpoint):
     # -- jitted programs ----------------------------------------------------
 
     def _build_step(self):
+        from wormhole_tpu.parallel.filters import quantize_dequantize
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         fixed_bytes = self.cfg.fixed_bytes
 

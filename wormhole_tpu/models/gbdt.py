@@ -346,16 +346,17 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
        pool — exact when total entries fit the cap, an ordinary
        merged-sketch approximation beyond it (same game as the dense
        path's ``_global_cuts``)."""
-    from jax.experimental import multihost_utils
-    from wormhole_tpu.parallel.collectives import allreduce_tree
+    from wormhole_tpu.parallel.collectives import (allgather_tree,
+                                                   allreduce_tree)
     ids_local = np.unique(ef_orig)
     n_max = int(allreduce_tree(np.int64(len(ids_local)), runtime.mesh,
-                               "max"))
+                               "max", site="gbdt/sketch_size"))
     if n_max == 0:
         raise FileNotFoundError("no entries on any host")
     buf = np.full(n_max, -1, np.int64)
     buf[:len(ids_local)] = ids_local
-    gathered = np.asarray(multihost_utils.process_allgather(buf)).ravel()
+    gathered = np.asarray(allgather_tree(buf, runtime.mesh,
+                                         site="gbdt/sketch")).ravel()
     feat_ids = np.unique(gathered[gathered >= 0])
     # deterministic entry sample: fixed-seed shuffle, then even stride.
     # A bare stride over stream positions is NOT value-neutral — entries
@@ -372,13 +373,14 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
                                        take).astype(np.int64)])
     else:
         sel = np.zeros(0, np.int64)
-    cap_max = int(allreduce_tree(np.int64(take), runtime.mesh, "max"))
+    cap_max = int(allreduce_tree(np.int64(take), runtime.mesh, "max",
+                                 site="gbdt/sketch_size"))
     ef_buf = np.full(cap_max, -1, np.int64)
     ev_buf = np.zeros(cap_max, np.float32)
     ef_buf[:take] = ef_orig[sel]
     ev_buf[:take] = ev[sel]
-    ef_m = np.asarray(multihost_utils.process_allgather(ef_buf)).ravel()
-    ev_m = np.asarray(multihost_utils.process_allgather(ev_buf)).ravel()
+    ef_m, ev_m = (np.asarray(a).ravel() for a in allgather_tree(
+        (ef_buf, ev_buf), runtime.mesh, site="gbdt/sketch"))
     keep = ef_m >= 0
     ef_m = np.searchsorted(feat_ids, ef_m[keep])
     cuts = _entry_quantile_cuts(ef_m, ev_m[keep], len(feat_ids), num_bins)
@@ -661,9 +663,13 @@ class GBDT:
                         kernel=cfg.gbdt_hist_kernel)
                     gl, hl = np.asarray(gl), np.asarray(hl)
                 # the per-level histogram allreduce (rabit → host
-                # collective); identity on a single process
+                # collective); identity on a single process. Site
+                # "gbdt/level_hist" is lossy-allowed: split decisions
+                # compare reduced sums identically on every host, and
+                # the error-feedback residual carries across levels
                 gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
-                                        compress=cfg.msg_compression)
+                                        compress=cfg.msg_compression,
+                                        site="gbdt/level_hist")
                 ghist = gl.astype(np.float64)
                 hhist = hl.astype(np.float64)
             else:
@@ -684,7 +690,8 @@ class GBDT:
                         kernel=cfg.gbdt_hist_kernel)
                     gl, hl = np.asarray(gl), np.asarray(hl)
                 gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
-                                        compress=cfg.msg_compression)
+                                        compress=cfg.msg_compression,
+                                        site="gbdt/level_hist")
                 ghist, hhist = _sibling_hists(gl, hl, prev_gh, prev_hh,
                                               active)
             prev_gh, prev_hh = ghist, hhist
@@ -731,15 +738,16 @@ class GBDT:
         if jax.process_count() == 1:
             _, cuts = quantile_bins(x, cfg.num_bins)
             return cuts
-        from jax.experimental import multihost_utils
-        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.parallel.collectives import (allgather_tree,
+                                                       allreduce_tree)
         cap = 1 << 16
         take = np.asarray(x[:cap], np.float32)
         n_max = int(allreduce_tree(np.int64(len(take)), self.rt.mesh,
-                                   "max"))
+                                   "max", site="gbdt/sketch_size"))
         buf = np.full((n_max, x.shape[1]), np.nan, np.float32)
         buf[:len(take)] = take
-        merged = np.asarray(multihost_utils.process_allgather(buf)
+        merged = np.asarray(allgather_tree(buf, self.rt.mesh,
+                                           site="gbdt/sketch")
                             ).reshape(-1, x.shape[1])
         qs = np.linspace(0, 100, cfg.num_bins + 1)[1:-1]
         return np.nanpercentile(merged, qs, axis=0).T.astype(np.float32)
@@ -803,7 +811,8 @@ class GBDT:
                 num_l = float(jnp.sum((margin - labels) ** 2 * mask))
             from wormhole_tpu.parallel.collectives import allreduce_tree
             num, den = allreduce_tree(
-                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
+                site="gbdt/eval")
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
             dh, _ = self._round_counters()
@@ -875,7 +884,8 @@ class GBDT:
             raise FileNotFoundError(f"no rows in {uri}")
         labels_np = np.concatenate(labels_parts).astype(np.float32)
         if jax.process_count() > 1 and not num_features:
-            F = int(allreduce_tree(np.int64(F), self.rt.mesh, "max"))
+            F = int(allreduce_tree(np.int64(F), self.rt.mesh, "max",
+                                   site="gbdt/num_features"))
         start_round = self._load_checkpoint(F)
         if self.cuts is None:
             sample_x = np.concatenate(
@@ -950,7 +960,8 @@ class GBDT:
             finally:
                 self._drain_chunk_stats(feed)
             num, den = allreduce_tree(
-                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
+                site="gbdt/eval")
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
             dh, ds = self._round_counters()
@@ -1013,7 +1024,8 @@ class GBDT:
             finally:
                 self._drain_chunk_stats(feed)
             gh, hh = allreduce_tree((gh, hh), self.rt.mesh,
-                                    compress=cfg.msg_compression)
+                                    compress=cfg.msg_compression,
+                                    site="gbdt/level_hist")
             if depth == 0:
                 gh = gh.astype(np.float64)
                 hh = hh.astype(np.float64)
@@ -1093,7 +1105,7 @@ class GBDT:
                                     for a in (gl, hl, gtl, htl))
             gl, hl, gtl, htl = allreduce_tree(
                 (gl, hl, gtl, htl), self.rt.mesh,
-                compress=cfg.msg_compression)
+                compress=cfg.msg_compression, site="gbdt/level_hist")
             if depth == 0:
                 gh, hh, gt, ht = (a.astype(np.float64)
                                   for a in (gl, hl, gtl, htl))
@@ -1181,7 +1193,8 @@ class GBDT:
             else:
                 num_l = float(jnp.sum((margin - labels) ** 2 * mask))
             num, den = allreduce_tree(
-                (np.float64(num_l), np.float64(den_l)), self.rt.mesh)
+                (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
+                site="gbdt/eval")
             metric = float(num) / max(float(den), 1.0)
             self.history.append(metric)
             dh, _ = self._round_counters()
@@ -1253,7 +1266,7 @@ class GBDT:
         red = allreduce_tree(
             {**{k: np.float64(v) for k, v in sums.items()},
              "pos": np.asarray(pos), "neg": np.asarray(neg)},
-            self.rt.mesh)
+            self.rt.mesh, site="gbdt/eval")
         n = max(float(red["n"]), 1.0)
         return {"auc": float(auc_from_hist(red["pos"], red["neg"])),
                 "accuracy": float(red["acc"]) / n,
@@ -1279,7 +1292,8 @@ class GBDT:
             # the _global_cuts collectives run) even when the checkpoint
             # dir is not shared: the slowest view wins
             from wormhole_tpu.parallel.collectives import allreduce_tree
-            ver = int(allreduce_tree(np.int64(ver), self.rt.mesh, "min"))
+            ver = int(allreduce_tree(np.int64(ver), self.rt.mesh, "min",
+                                     site="gbdt/ckpt_ver"))
         if not ver:
             return 0
         template = {"trees": [self._ckpt_template() for _ in range(ver)],
@@ -1533,7 +1547,8 @@ def main(argv=None) -> int:
         if rt.world > 1 and not cli.num_features:
             # hosts must agree on the column count (the reference's
             # rabit::Allreduce<op::Max>, lbfgs-linear/linear.cc:110)
-            F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max"))
+            F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max",
+                                   site="gbdt/num_features"))
             if x.shape[1] < F:
                 x = np.pad(x, ((0, 0), (0, F - x.shape[1])))
         model.fit(x, y)

@@ -202,7 +202,8 @@ class KMeans:
         if n_have < k:
             cent[n_have:] = rng.standard_normal((k - n_have, f)) * 0.01
         from wormhole_tpu.parallel.collectives import broadcast_tree
-        cent = broadcast_tree(cent, self.rt.mesh, root=0)
+        cent = broadcast_tree(cent, self.rt.mesh, root=0,
+                              site="kmeans/init_centroids")
         state = KMeansState(
             centroids=np.asarray(normalize_rows(jnp.asarray(cent))),
             version=np.zeros((), np.int32))
@@ -225,10 +226,14 @@ class KMeans:
             # cross-host Sum-allreduce (rabit::Allreduce<Sum> with the
             # omp_get_centroid prepare-fn, kmeans.cc:249 — the lazy-replay
             # half of that contract is moot here, see collectives.py)
+            # site "kmeans/stats" is lossy-allowed (filters.py): the
+            # scalar objv/seen leaves stay exact regardless (below the
+            # quantizer's size floor); only the (K,F)/(K,) folds may
+            # quantize, with error feedback carrying across iterations
             sums, counts, objv, seen = jax.tree.map(
                 jnp.asarray,
                 allreduce_tree(jax.tree.map(np.asarray, stats),
-                               self.rt.mesh, "sum"))
+                               self.rt.mesh, "sum", site="kmeans/stats"))
         new_state = _recompute(state, sums, counts)
         mean_objv = float(objv) / max(float(seen), 1.0)
         return new_state, mean_objv

@@ -86,10 +86,16 @@ class LinearObjective:
         self.reg_l2 = reg_l2
         self.rt = runtime
 
-    def _cross_host(self, tree):
+    def _cross_host(self, tree, site: str):
+        """Cross-host fold behind one seam (the solver's collective
+        boundary — tests and the bench's filtered-training check swap
+        this attribute for a FilterChain.roundtrip loopback). Site ids
+        follow docs/comm.md: "linear/grad" is lossy-allowed (gradient
+        descent direction, error-fed); the line-search and convergence
+        objectives reduce at exact sites."""
         if self.rt is not None and jax.process_count() > 1:
             return allreduce_tree(jax.tree.map(np.asarray, tree),
-                                  self.rt.mesh, "sum")
+                                  self.rt.mesh, "sum", site=site)
         return tree
 
     def calc_grad(self, w):
@@ -98,7 +104,7 @@ class LinearObjective:
         for b in self.batches:
             o, g = _grad_batch(w, b, self.objv_fn, self.dual_fn)
             objv, grad = objv + o, grad + g
-        objv, grad = self._cross_host((objv, grad))
+        objv, grad = self._cross_host((objv, grad), "linear/grad")
         if self.reg_l2:
             objv = objv + 0.5 * self.reg_l2 * jnp.sum(w * w)
             grad = grad + self.reg_l2 * w
@@ -108,7 +114,7 @@ class LinearObjective:
         objv = jnp.zeros((), jnp.float32)
         for b in self.batches:
             objv = objv + _objv_batch(w, b, self.objv_fn)
-        objv = self._cross_host(objv)
+        objv = self._cross_host(objv, "linear/objv")
         if self.reg_l2:
             objv = objv + 0.5 * self.reg_l2 * jnp.sum(w * w)
         return jnp.asarray(objv)
@@ -127,7 +133,8 @@ class LinearObjective:
         def objv_at(alpha: float):
             v = _objv_at_alpha(jnp.asarray(alpha, jnp.float32), mw, md,
                                labels, masks, self.objv_fn)
-            v = float(self._cross_host(np.asarray(v)))
+            v = float(self._cross_host(np.asarray(v),
+                                       "linear/linesearch"))
             # reg added after the allreduce, same as calc_grad/objv
             return v + 0.5 * self.reg_l2 * (
                 ww + 2.0 * alpha * wd + alpha * alpha * dd)

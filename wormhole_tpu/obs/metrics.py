@@ -243,9 +243,14 @@ class Registry:
             [self._metrics[n].value
              if getattr(self._metrics[n], "agg", "") == "min" else np.inf
              for n in scalars], np.float64)
-        sums = np.asarray(allreduce_tree(sums, mesh, "sum"))
-        maxs = np.asarray(allreduce_tree(maxs, mesh, "max"))
-        mins = np.asarray(allreduce_tree(mins, mesh, "min"))
+        # site "obs/registry" is NOT in the lossy allowlist: metric
+        # counters merge bit-exact (docs/comm.md's exact-semantics rule)
+        sums = np.asarray(allreduce_tree(sums, mesh, "sum",
+                                         site="obs/registry"))
+        maxs = np.asarray(allreduce_tree(maxs, mesh, "max",
+                                         site="obs/registry"))
+        mins = np.asarray(allreduce_tree(mins, mesh, "min",
+                                         site="obs/registry"))
         for i, n in enumerate(scalars):
             m = self._metrics[n]
             if m.kind == "counter" or getattr(m, "agg", "") == "sum":
@@ -259,11 +264,13 @@ class Registry:
             if m.kind != "histogram":
                 continue
             vec = np.array(m.bins + [m.count], np.float64)
-            vec = np.asarray(allreduce_tree(vec, mesh, "sum"))
+            vec = np.asarray(allreduce_tree(vec, mesh, "sum",
+                                            site="obs/registry"))
             m.bins = [int(v) for v in vec[:-1]]
             m.count = int(vec[-1])
             m.sum = float(np.asarray(
-                allreduce_tree(np.float64(m.sum), mesh, "sum")))
+                allreduce_tree(np.float64(m.sum), mesh, "sum",
+                               site="obs/registry")))
 
     # -- adapters: the legacy metric surfaces --------------------------------
 

@@ -112,20 +112,26 @@ def _record(ph: str, name: str, cat: str, ts: float, dur: float,
     _RING.append((ph, name, cat, (ts - _T0) * 1e6, dur * 1e6, tid, arg))
 
 
-def complete(name: str, t0: float, dur: float, cat: str = "") -> None:
+def complete(name: str, t0: float, dur: float, cat: str = "",
+             args: Optional[dict] = None) -> None:
     """Record a completed span: ``t0`` is the ``time.monotonic()`` start,
     ``dur`` seconds. This is the hot-path entry point — callers that
     already measured a duration (Timer.scope, DeviceFeed stages) hand it
-    over instead of paying a second context-manager frame."""
+    over instead of paying a second context-manager frame. ``args``
+    (optional dict) lands as the event's Perfetto args panel."""
     if not _ENABLED:
         return
-    _record(_PH_COMPLETE, name, cat, t0, dur)
+    _record(_PH_COMPLETE, name, cat, t0, dur,
+            dict(args) if args else None)
 
 
 @contextmanager
-def span(name: str, cat: str = "") -> Iterator[None]:
+def span(name: str, cat: str = "",
+         args: Optional[dict] = None) -> Iterator[None]:
     """``with trace.span("checkpoint:save"): ...`` — a no-op (single
-    bool check) while tracing is off."""
+    bool check) while tracing is off. A mutable ``args`` dict may be
+    filled *inside* the span (payload sizes known only after encoding);
+    it is snapshotted when the span closes."""
     if not _ENABLED:
         yield
         return
@@ -133,7 +139,8 @@ def span(name: str, cat: str = "") -> Iterator[None]:
     try:
         yield
     finally:
-        _record(_PH_COMPLETE, name, cat, t0, time.monotonic() - t0)
+        _record(_PH_COMPLETE, name, cat, t0, time.monotonic() - t0,
+                dict(args) if args else None)
 
 
 def instant(name: str, cat: str = "") -> None:
@@ -159,6 +166,8 @@ def events() -> list:
             ev["cat"] = cat
         if ph == _PH_COMPLETE:
             ev["dur"] = round(dur, 3)
+            if arg:
+                ev["args"] = arg
         elif ph == _PH_INSTANT:
             ev["s"] = "t"
         elif ph == _PH_COUNTER:

@@ -1,4 +1,9 @@
 from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
-from wormhole_tpu.parallel.collectives import (allreduce_tree, broadcast_tree,
+from wormhole_tpu.parallel.collectives import (allreduce_tree,
+                                               allgather_tree,
+                                               broadcast_tree,
+                                               host_local_to_global,
                                                psum_tree)
 from wormhole_tpu.parallel.checkpoint import Checkpointer
+from wormhole_tpu.parallel.filters import (FilterChain, get_chain,
+                                           set_chain, install_from_config)

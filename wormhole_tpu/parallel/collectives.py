@@ -49,9 +49,54 @@ def pmin_tree(tree: Any, axis: str) -> Any:
 # ---------------------------------------------------------------------------
 # host-level collectives over a mesh
 # ---------------------------------------------------------------------------
+#
+# Every DCN hop below consults the process-global FilterChain
+# (parallel/filters.py — ps-lite's KEY_CACHING / FIXING_FLOAT /
+# COMPRESSING ported to pytrees). With no chain installed (the default)
+# the original unfiltered transport runs untouched. ``site`` is the
+# filter-chain contract: a stable, per-call-site string identical on
+# every host (see docs/comm.md) — it keys the key cache and the
+# error-feedback residuals, and labels the wire-byte accounting.
+
+def _resolve_chain(site, compress: bool):
+    """The chain this call should route through: the installed global
+    chain when active, else a compression-only fallback for legacy
+    ``compress=True`` callers (the pre-filters zlib leaf codec)."""
+    from wormhole_tpu.parallel import filters
+    chain = filters.get_chain()
+    if chain is not None and chain.active_for(site):
+        return chain
+    if compress:
+        global _LEGACY_Z
+        if _LEGACY_Z is None:
+            _LEGACY_Z = filters.FilterChain(filters={"compressing"},
+                                            min_bytes=0)
+        return _LEGACY_Z
+    return None
+
+
+_LEGACY_Z = None
+
+
+def _exchange_leaf(chain, site, idx, x, op):
+    """Ship one encoded leaf through a padded fixed-shape allgather and
+    decode every host's contribution. The gather pads each buffer to the
+    max wire length; decode slices back to the *sender's* true length
+    and the signature's dtype, so padding and dtype survive exactly
+    (f16, non-contiguous and int leaves included)."""
+    from jax.experimental import multihost_utils
+    buf = chain.encode_leaf(site, idx, x, op)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.int64(len(buf))))
+    pad = np.zeros(int(lens.max()), np.uint8)
+    pad[:len(buf)] = np.frombuffer(buf, np.uint8)
+    g = np.asarray(multihost_utils.process_allgather(pad))
+    return [chain.decode_leaf(site, idx, g[r, :int(lens[r])].tobytes())
+            for r in range(g.shape[0])]
+
 
 def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
-                   compress: bool = False) -> Any:
+                   compress: bool = False, site: str = None) -> Any:
     """Sum/max/min-allreduce a host-local pytree across the data-parallel
     world (rabit::Allreduce analogue).
 
@@ -59,49 +104,108 @@ def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
     single process this is the identity for 'sum' *per device contribution*
     semantics: the caller holds one logical copy, so no scaling happens.
 
-    ``compress`` zlib-compresses each leaf's payload for the DCN hop (the
-    ps-lite COMPRESSING filter, async_sgd.h:144-154 / config.proto:100) —
-    worthwhile for large, compressible buffers like gradient histograms;
-    pure overhead for tiny ones."""
+    ``mesh`` is carried for API symmetry with the in-jit collectives and
+    future sharded transports; the host transport rides
+    ``process_allgather``, which spans all processes regardless of mesh
+    shape, so a None mesh (tests, ad-hoc tools) is accepted.
+
+    ``compress`` (legacy knob, pre-dating the filter chain) routes the
+    call through a compression-only chain; an installed FilterChain
+    (filters.install_from_config) supersedes it and adds KEY_CACHING /
+    FIXING_FLOAT per ``site``."""
     # span recorded on the single-process fast path too: the boundary is
     # where the sync would be, which is what a trace reader looks for
-    with trace.span(f"collective:allreduce_{op}", cat="collective"):
+    attrs = {"site": site} if site else None
+    with trace.span(f"collective:allreduce_{op}", cat="collective",
+                    args=attrs):
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils
         npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
         fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+        chain = _resolve_chain(site, compress)
+        if chain is not None:
+            leaves, treedef = jax.tree.flatten(tree)
+            raw0, wire0 = (chain.stats["bytes_raw"],
+                           chain.stats["bytes_wire"])
+            out = [npfn(np.stack(
+                       _exchange_leaf(chain, site, i, x, op)), axis=0)
+                   for i, x in enumerate(leaves)]
+            if attrs is not None:
+                attrs["bytes_raw"] = chain.stats["bytes_raw"] - raw0
+                attrs["bytes_wire"] = chain.stats["bytes_wire"] - wire0
+            return jax.tree.unflatten(treedef, out)
 
         def reduce_leaf(x):
             gathered = multihost_utils.process_allgather(jnp.asarray(x))
             return np.asarray(fn(gathered, axis=0))
 
-        def reduce_leaf_z(x):
-            import zlib
-            x = np.asarray(x)
-            comp = zlib.compress(x.tobytes(), 1)
-            lens = np.asarray(multihost_utils.process_allgather(
-                np.int64(len(comp))))
-            buf = np.zeros(int(lens.max()), np.uint8)
-            buf[:len(comp)] = np.frombuffer(comp, np.uint8)
-            g = np.asarray(multihost_utils.process_allgather(buf))
-            parts = [np.frombuffer(zlib.decompress(
-                         g[r, :int(lens[r])].tobytes()),
-                         x.dtype).reshape(x.shape)
-                     for r in range(g.shape[0])]
-            return npfn(np.stack(parts), axis=0)
-
-        return jax.tree.map(reduce_leaf_z if compress else reduce_leaf,
-                            tree)
+        return jax.tree.map(reduce_leaf, tree)
 
 
-def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0) -> Any:
-    """rabit::Broadcast analogue: every process returns root's values."""
-    with trace.span("collective:broadcast", cat="collective"):
+def allgather_tree(tree: Any, mesh: Mesh, site: str = None) -> Any:
+    """Allgather a host-local pytree: every leaf gains a leading
+    process axis (rank order). The sanctioned route to
+    ``process_allgather`` — it rides the filter chain's lossless stages
+    (KEY_CACHING + COMPRESSING; never FIXING_FLOAT: a gather is not a
+    reduction, every rank's exact payload comes back) and books wire
+    bytes like every other collective."""
+    with trace.span("collective:allgather", cat="collective",
+                    args={"site": site} if site else None):
+        if jax.process_count() == 1:
+            return jax.tree.map(lambda x: np.asarray(x)[None], tree)
+        from jax.experimental import multihost_utils
+        chain = _resolve_chain(site, False)
+        if chain is not None:
+            leaves, treedef = jax.tree.flatten(tree)
+            out = [np.stack(_exchange_leaf(chain, site, i, x, "gather"))
+                   for i, x in enumerate(leaves)]
+            return jax.tree.unflatten(treedef, out)
+        return jax.tree.map(
+            lambda x: np.asarray(
+                multihost_utils.process_allgather(jnp.asarray(x))), tree)
+
+
+def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
+                   site: str = None) -> Any:
+    """rabit::Broadcast analogue: every process returns root's values.
+
+    With a filter chain installed the root's leaves ship encoded
+    (lossless stages only) — one extra length broadcast per leaf buys
+    compressed payloads on the DCN hop."""
+    with trace.span("collective:broadcast", cat="collective",
+                    args={"site": site} if site else None):
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils
+        chain = _resolve_chain(site, False)
+        if chain is not None:
+            src = jax.process_index() == root
+            leaves, treedef = jax.tree.flatten(tree)
+            out = []
+            for i, x in enumerate(leaves):
+                buf = (chain.encode_leaf(site, i, x, "bcast")
+                       if src else b"")
+                n = int(np.asarray(multihost_utils.broadcast_one_to_all(
+                    np.int64(len(buf)), is_source=src)))
+                pad = np.zeros(n, np.uint8)
+                if src:
+                    pad[:len(buf)] = np.frombuffer(buf, np.uint8)
+                g = np.asarray(multihost_utils.broadcast_one_to_all(
+                    pad, is_source=src))
+                out.append(chain.decode_leaf(site, i, g.tobytes()))
+            return jax.tree.unflatten(treedef, out)
         return multihost_utils.broadcast_one_to_all(
             tree, is_source=jax.process_index() == root)
+
+
+def host_local_to_global(tree: Any, mesh: Mesh, pspec) -> Any:
+    """``multihost_utils.host_local_array_to_global_array`` behind the
+    parallel/ boundary (scripts/lint_collectives.py forbids direct use
+    elsewhere). No filtering: this is the device-feed assembly path —
+    the bytes move host→device, not across the DCN."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(
+        tree, mesh, pspec)
 
 

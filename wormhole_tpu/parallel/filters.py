@@ -1,0 +1,445 @@
+"""ps-lite communication filters for host-level collectives.
+
+The reference's Criteo-scale numbers lean on three message filters
+(Li et al., OSDI'14 §5.1; ps-lite ``filter.h`` / ``config.proto:96-104``)
+applied to every push/pull:
+
+- **KEY_CACHING** — both ends cache the key list of a repeated message
+  and ship only a digest when it is unchanged. The pytree port caches
+  each collective *site*'s leaf signature (dtype, shape, quantization)
+  keyed by a caller-supplied site id, so the per-window metric
+  allreduces and per-level histogram syncs stop re-negotiating
+  metadata every round.
+- **FIXING_FLOAT** — fixed-point b-bit quantization of float payloads.
+  Lossy compression of a *repeated* reduction is only safe with error
+  feedback (Seide et al., Interspeech'14): each host quantizes
+  ``x + residual`` and carries ``residual = (x + residual) - q`` into
+  the next round, so the quantization error telescopes instead of
+  accumulating. Gated by a per-site allowlist — exact-semantics trees
+  (progress counters, convergence tests, checkpoint versions) always
+  bypass it — and applied only to ``sum`` reductions of float leaves.
+- **COMPRESSING** — lossless wire compression: a zero-run-length
+  pre-pass (gradient histograms are mostly empty) followed by zlib,
+  skipped below ``min_bytes`` where the header would cost more than
+  it saves.
+
+Filters compose in a :class:`FilterChain`; ``allreduce_tree`` /
+``broadcast_tree`` (collectives.py) consult the installed chain for
+every leaf and account raw vs wire bytes into the obs Registry
+(``comm/bytes_raw``, ``comm/bytes_wire``, ``comm/filter_saved``) and
+onto the ``collective:*`` trace spans. Everything is **off by
+default**: with no chain installed the collectives run their original
+unfiltered path untouched.
+
+Wire format (one buffer per leaf)::
+
+    flags:u8 | header | [scale:f64 qbits:u8] | payload_len:u32 | payload
+
+    flags bit0  payload is quantized codes (int8/int16), not raw dtype
+          bit1  payload is zlib-compressed
+          bit2  payload had the zero-RLE pre-pass (applied before zlib)
+          bit3  header is the full signature; else an 8-byte digest
+    header  full:   sig_len:u16 | sig bytes ("dtype|qdtype|d0,d1,...")
+            cached: digest:8B   (blake2b-8 of the sig, known from an
+                                 earlier full header at this site)
+
+Decoding honours the signature's dtype and the exact payload byte
+length — the transport pads every host's buffer to the max length for
+the fixed-shape allgather, and the trailing pad must never leak into
+``np.frombuffer``.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from hashlib import blake2b
+from typing import Any, Dict, Optional, Set, Tuple
+
+import numpy as np
+
+__all__ = ["FilterChain", "FILTER_NAMES", "DEFAULT_LOSSY_SITES",
+           "get_chain", "set_chain", "install_from_config",
+           "quantize_dequantize", "quantize_np", "dequantize_np"]
+
+FILTER_NAMES = ("key_caching", "fixing_float", "compressing")
+
+# Sites where lossy (FIXING_FLOAT) exchange is semantically safe: large
+# float accumulators that feed gradient-descent-style updates, where a
+# bounded, error-fed quantization noise perturbs the *path* but not the
+# fixed point. Everything NOT listed here — progress counters, version
+# mins, convergence numerators, sketch sizes — stays bit-exact.
+DEFAULT_LOSSY_SITES: Set[str] = {
+    "linear/grad",        # models/linear.py: (objv, grad) L-BFGS reduce
+    "kmeans/stats",       # models/kmeans.py: per-iter sums/counts fold
+    "gbdt/level_hist",    # models/gbdt.py: per-level grad/hess hists
+    "async_sgd/auc_hist", # learners/async_sgd.py: pooled-AUC histograms
+    "bench/grad_hist",    # bench.py comm_filters phase payload
+}
+
+_FLAG_QUANT = 1
+_FLAG_ZLIB = 2
+_FLAG_RLE = 4
+_FLAG_FULLHDR = 8
+
+# Leaves smaller than this never quantize: the f64 scale + header
+# amortizes poorly, and tiny leaves are usually scalars with exact
+# semantics (a loss value riding in a (objv, grad) tuple).
+_QUANT_MIN_ELEMS = 64
+
+
+# ---------------------------------------------------------------------------
+# quantizer — the single implementation (store.py's in-jit user imports
+# quantize_dequantize; the wire codec uses the numpy split pair)
+# ---------------------------------------------------------------------------
+
+def quantize_dequantize(g, bits: int):
+    """In-jit fixed-point round trip (the FIXING_FLOAT value transform):
+    symmetric b-bit quantization around zero. jax-traceable; used by
+    learners/store.py inside the compiled step when
+    ``StoreConfig.fixed_bytes`` is set."""
+    import jax.numpy as jnp
+    scale = jnp.max(jnp.abs(g)) + 1e-30
+    levels = float(2 ** (bits - 1) - 1)
+    q = jnp.round(g / scale * levels)
+    return q * (scale / levels)
+
+
+def _code_dtype(bits: int):
+    return np.int8 if bits <= 8 else np.int16
+
+
+def quantize_np(x: np.ndarray, bits: int) -> Tuple[np.ndarray, float]:
+    """Host-side split quantizer: returns (integer codes, scale). Shares
+    semantics with :func:`quantize_dequantize` — ``dequantize_np(
+    *quantize_np(x, b), b, x.dtype)`` equals the in-jit round trip."""
+    scale = float(np.max(np.abs(x))) + 1e-30
+    levels = float(2 ** (bits - 1) - 1)
+    codes = np.round(np.asarray(x, np.float64) / scale * levels)
+    return codes.astype(_code_dtype(bits)), scale
+
+
+def dequantize_np(codes: np.ndarray, scale: float, bits: int,
+                  dtype) -> np.ndarray:
+    levels = float(2 ** (bits - 1) - 1)
+    out = codes.astype(np.float64) * (scale / levels)
+    return out.astype(dtype, copy=False)
+
+
+# ---------------------------------------------------------------------------
+# zero-run-length pre-pass (COMPRESSING stage 1)
+# ---------------------------------------------------------------------------
+
+_RLE_MIN_RUN_WORDS = 4  # only runs >= 32 zero bytes earn their record
+
+
+def rle_encode(raw: bytes) -> Optional[bytes]:
+    """Zero-run-length encode ``raw``; None when it would not shrink.
+    Format: total_len:u32 then (lit_len:u32 zero_len:u32 lit-bytes)*
+    records; zero runs are detected on 8-byte words so the scan is one
+    vectorized pass, not a byte loop."""
+    n = len(raw)
+    if n < 64:
+        return None
+    a = np.frombuffer(raw, np.uint8)
+    pad = (-n) % 8
+    if pad:
+        a = np.concatenate([a, np.zeros(pad, np.uint8)])
+    z = a.view(np.uint64) == 0
+    d = np.diff(z.astype(np.int8))
+    starts = np.flatnonzero(d == 1) + 1
+    ends = np.flatnonzero(d == -1) + 1
+    if z[0]:
+        starts = np.concatenate([[0], starts])
+    if z[-1]:
+        ends = np.concatenate([ends, [z.size]])
+    keep = (ends - starts) >= _RLE_MIN_RUN_WORDS
+    starts, ends = starts[keep], ends[keep]
+    if starts.size == 0:
+        return None
+    out = bytearray(struct.pack("<I", n))
+    pos = 0
+    for s, e in zip(starts, ends):
+        lit = raw[pos * 8:int(s) * 8]
+        out += struct.pack("<II", len(lit), (int(e) - int(s)) * 8)
+        out += lit
+        pos = int(e)
+    tail = raw[pos * 8:n]
+    if tail:
+        out += struct.pack("<II", len(tail), 0)
+        out += tail
+    return bytes(out) if len(out) < n else None
+
+
+def rle_decode(buf: bytes) -> bytes:
+    (n,) = struct.unpack_from("<I", buf, 0)
+    out = bytearray()
+    off = 4
+    while off < len(buf):
+        ll, zl = struct.unpack_from("<II", buf, off)
+        off += 8
+        out += buf[off:off + ll]
+        off += ll
+        out += b"\x00" * zl
+    # the final zero run may have been padded to an 8-byte word boundary
+    return bytes(out[:n])
+
+
+# ---------------------------------------------------------------------------
+# FilterChain
+# ---------------------------------------------------------------------------
+
+def _sig_bytes(dtype: np.dtype, qdtype: str, shape: Tuple[int, ...]) -> bytes:
+    # ';'-separated: numpy dtype strs use '|' for single-byte types
+    dims = ",".join(str(int(d)) for d in shape)
+    return f"{np.dtype(dtype).str};{qdtype};{dims}".encode()
+
+
+def _parse_sig(sig: bytes) -> Tuple[np.dtype, str, Tuple[int, ...]]:
+    dt, qdt, dims = sig.decode().split(";")
+    shape = tuple(int(d) for d in dims.split(",")) if dims else ()
+    return np.dtype(dt), qdt, shape
+
+
+@dataclass
+class FilterChain:
+    """A composable, stateful encode/decode pipeline for collective
+    payloads. One chain instance == one host's view: the per-site
+    error-feedback residuals and key caches live here. Simulated
+    multi-host tests build one chain per fake host.
+
+    ``filters`` is any subset of :data:`FILTER_NAMES`; an empty set is
+    the identity (``active_for`` returns False and the collectives skip
+    the codec entirely)."""
+
+    filters: Set[str] = field(default_factory=set)
+    quant_bits: int = 8
+    min_bytes: int = 1024
+    lossy_sites: Set[str] = field(
+        default_factory=lambda: set(DEFAULT_LOSSY_SITES))
+    # wire-byte accounting, also mirrored into the obs Registry
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "bytes_raw": 0, "bytes_wire": 0})
+
+    def __post_init__(self) -> None:
+        bad = set(self.filters) - set(FILTER_NAMES)
+        if bad:
+            raise ValueError(f"unknown comm filters: {sorted(bad)} "
+                             f"(choose from {FILTER_NAMES})")
+        if not 2 <= int(self.quant_bits) <= 16:
+            raise ValueError("comm_quant_bits must be in [2, 16], got "
+                             f"{self.quant_bits}")
+        # encoder side: site -> (digest, sig) of the last full header sent
+        self._enc_sigs: Dict[Tuple[str, int], Tuple[bytes, bytes]] = {}
+        # decoder side: (site, leaf) -> {digest: sig} learned from peers
+        self._dec_sigs: Dict[Tuple[str, int], Dict[bytes, bytes]] = {}
+        # error-feedback residuals: (site, leaf) -> float64 carry
+        self._residual: Dict[Tuple[str, int], np.ndarray] = {}
+
+    # -- predicates ---------------------------------------------------------
+
+    def active_for(self, site: Optional[str]) -> bool:
+        """Whether this chain transforms payloads at all. Site-less
+        call sites still get compression/accounting; KeyCaching and
+        FixingFloat need a stable site id."""
+        return bool(self.filters)
+
+    def _quantizes(self, site: Optional[str], x: np.ndarray,
+                   op: str) -> bool:
+        return ("fixing_float" in self.filters
+                and site is not None and site in self.lossy_sites
+                and op == "sum"
+                and x.dtype.kind == "f"
+                and x.size >= _QUANT_MIN_ELEMS)
+
+    # -- per-leaf codec -----------------------------------------------------
+
+    def encode_leaf(self, site: Optional[str], leaf: int, x: Any,
+                    op: str = "sum") -> bytes:
+        """Encode one leaf's local contribution for the wire. Applies
+        FIXING_FLOAT (with residual carry) when the site allows lossy,
+        then the zero-RLE + zlib COMPRESSING stage, then KEY_CACHING on
+        the metadata header."""
+        x = np.asarray(x)
+        if not x.flags.c_contiguous:
+            # NOT ascontiguousarray unconditionally: it promotes 0-d
+            # scalars to shape (1,), and the decoded shape must match
+            x = np.ascontiguousarray(x)
+        raw_nbytes = x.nbytes
+        flags = 0
+        scale = 0.0
+        qdtype = ""
+        if self._quantizes(site, x, op):
+            key = (site, leaf)
+            r = self._residual.get(key)
+            if r is None or r.shape != x.shape:
+                r = np.zeros(x.shape, np.float64)
+            y = np.asarray(x, np.float64) + r
+            codes, scale = quantize_np(y, self.quant_bits)
+            self._residual[key] = y - dequantize_np(
+                codes, scale, self.quant_bits, np.float64)
+            payload_arr = codes
+            qdtype = codes.dtype.str
+            flags |= _FLAG_QUANT
+        else:
+            payload_arr = x
+        payload = payload_arr.tobytes()
+        if "compressing" in self.filters and len(payload) >= self.min_bytes:
+            rle = rle_encode(payload)
+            if rle is not None:
+                payload = rle
+                flags |= _FLAG_RLE
+            comp = zlib.compress(payload, 1)
+            if len(comp) < len(payload):
+                payload = comp
+                flags |= _FLAG_ZLIB
+        sig = _sig_bytes(x.dtype, qdtype, x.shape)
+        digest = blake2b(sig, digest_size=8).digest()
+        cached = ("key_caching" in self.filters
+                  and self._enc_sigs.get((site or "", leaf)) == (digest, sig))
+        if cached:
+            header = digest
+        else:
+            header = struct.pack("<H", len(sig)) + sig
+            flags |= _FLAG_FULLHDR
+            if "key_caching" in self.filters:
+                self._enc_sigs[(site or "", leaf)] = (digest, sig)
+        parts = [struct.pack("<B", flags), header]
+        if flags & _FLAG_QUANT:
+            parts.append(struct.pack("<dB", scale, self.quant_bits))
+        parts.append(struct.pack("<I", len(payload)))
+        parts.append(payload)
+        buf = b"".join(parts)
+        self.stats["bytes_raw"] += raw_nbytes
+        self.stats["bytes_wire"] += len(buf)
+        self._account(raw_nbytes, len(buf))
+        return buf
+
+    def decode_leaf(self, site: Optional[str], leaf: int,
+                    buf: bytes) -> np.ndarray:
+        """Invert :meth:`encode_leaf` on exactly ``len(buf)`` bytes —
+        callers slice the padded gather buffer to the sender's true
+        length before handing it over."""
+        (flags,) = struct.unpack_from("<B", buf, 0)
+        off = 1
+        key = (site or "", leaf)
+        if flags & _FLAG_FULLHDR:
+            (slen,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            sig = buf[off:off + slen]
+            off += slen
+            digest = blake2b(sig, digest_size=8).digest()
+            self._dec_sigs.setdefault(key, {})[digest] = sig
+        else:
+            digest = buf[off:off + 8]
+            off += 8
+            sig = self._dec_sigs.get(key, {}).get(digest)
+            if sig is None:
+                raise ValueError(
+                    f"KEY_CACHING digest for site {site!r} leaf {leaf} "
+                    "not in cache — encoder/decoder site sequences "
+                    "diverged (site ids must be stable and identical "
+                    "on every host)")
+        dtype, qdtype, shape = _parse_sig(sig)
+        scale, bits = 0.0, 0
+        if flags & _FLAG_QUANT:
+            scale, bits = struct.unpack_from("<dB", buf, off)
+            off += 9
+        (plen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        payload = buf[off:off + plen]
+        if len(payload) != plen:
+            raise ValueError(
+                f"truncated payload at site {site!r} leaf {leaf}: "
+                f"have {len(payload)} of {plen} bytes")
+        if flags & _FLAG_ZLIB:
+            payload = zlib.decompress(payload)
+        if flags & _FLAG_RLE:
+            payload = rle_decode(payload)
+        if flags & _FLAG_QUANT:
+            codes = np.frombuffer(payload, np.dtype(qdtype)).reshape(shape)
+            return dequantize_np(codes, scale, bits, dtype)
+        return np.frombuffer(payload, dtype).reshape(shape).copy()
+
+    # -- loopback (bench / tests / single-host filtered training) -----------
+
+    def roundtrip(self, tree: Any, site: Optional[str],
+                  op: str = "sum") -> Any:
+        """Encode+decode every leaf locally — the single-host loopback.
+        Exercises the full wire format including residual carry, so the
+        bench can measure wire bytes and tests can pin parity without a
+        multi-process launch. Identity (same object) when the chain is
+        inactive."""
+        if not self.active_for(site):
+            return tree
+        import jax
+        leaves, treedef = jax.tree.flatten(tree)
+        out = [self.decode_leaf(site, i, self.encode_leaf(site, i, x, op))
+               for i, x in enumerate(leaves)]
+        return jax.tree.unflatten(treedef, out)
+
+    def ratio(self) -> float:
+        """Cumulative raw/wire compression ratio (1.0 when nothing has
+        flowed)."""
+        w = self.stats["bytes_wire"]
+        return (self.stats["bytes_raw"] / w) if w else 1.0
+
+    # -- obs accounting -----------------------------------------------------
+
+    def _account(self, raw: int, wire: int) -> None:
+        c = _comm_counters()
+        if c is not None:
+            c[0].inc(raw)
+            c[1].inc(wire)
+            c[2].inc(max(raw - wire, 0))
+
+
+def _comm_counters():
+    """The single declaration site (lint_knobs contract) for the comm
+    byte counters; fetched per call so a cleared/replaced default
+    registry can never strand stale Counter objects."""
+    try:
+        from wormhole_tpu.obs.metrics import default_registry
+    except Exception:
+        return None
+    reg = default_registry()
+    return (reg.counter("comm/bytes_raw"),
+            reg.counter("comm/bytes_wire"),
+            reg.counter("comm/filter_saved"))
+
+
+# ---------------------------------------------------------------------------
+# process-global chain (what the collectives consult)
+# ---------------------------------------------------------------------------
+
+_CHAIN: Optional[FilterChain] = None
+
+
+def get_chain() -> Optional[FilterChain]:
+    return _CHAIN
+
+
+def set_chain(chain: Optional[FilterChain]) -> Optional[FilterChain]:
+    """Install ``chain`` as the process-global filter chain (None
+    uninstalls). Returns the previous chain so callers can restore."""
+    global _CHAIN
+    prev, _CHAIN = _CHAIN, chain
+    return prev
+
+
+def install_from_config(cfg) -> Optional[FilterChain]:
+    """Build + install a chain from Config's ``comm_filters`` /
+    ``comm_quant_bits`` / ``comm_compress_min_bytes`` knobs. An empty
+    ``comm_filters`` uninstalls (the default: collectives untouched)."""
+    names = {t.strip() for t in str(
+        getattr(cfg, "comm_filters", "") or "").split(",") if t.strip()}
+    if not names:
+        set_chain(None)
+        return None
+    chain = FilterChain(
+        filters=names,
+        quant_bits=int(getattr(cfg, "comm_quant_bits", 8)),
+        min_bytes=int(getattr(cfg, "comm_compress_min_bytes", 1024)))
+    set_chain(chain)
+    return chain

@@ -43,7 +43,14 @@ log = get_logger("lbfgs")
 
 
 class Objective(Protocol):
-    """The IObjFunction surface (lbfgs.h:22-52), functional."""
+    """The IObjFunction surface (lbfgs.h:22-52), functional.
+
+    Implementations own the solver's cross-host collective boundary and
+    must follow the site-id contract (docs/comm.md): ``calc_grad``'s
+    reduction may use a lossy-allowed site ("linear/grad" — gradient
+    noise is error-fed and self-correcting), but ``objv`` and the
+    line-search evaluations feed Armijo/convergence *comparisons* and
+    must reduce at exact sites, or hosts could disagree on termination."""
 
     num_features: int
 

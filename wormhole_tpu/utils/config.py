@@ -106,12 +106,16 @@ class Config:
     lr_theta: float = 1.0
 
     # --- sync-cost reduction ---
-    # (the reference's KEY_CACHING filter has no analogue here BY DESIGN:
-    # keys never transit a network — text-path batches fold keys on the
-    # host feeding its own devices, and the crec paths fold them on
-    # device — so there is no repeated key vector to cache. COMPRESSING
-    # survives as `msg_compression` below, applied to the host-collective
-    # payloads on the DCN path; FIXING_FLOAT as `fixed_bytes`.)
+    # The reference's ps-lite message filters (KEY_CACHING / COMPRESSING
+    # / FIXING_FLOAT, OSDI'14 §5.1) live in parallel/filters.py, ported
+    # from the key-vector wire format to pytree *collective sites*:
+    # keys never transit our network (text-path batches fold keys on the
+    # host feeding its own devices, crec paths fold them on device), so
+    # KEY_CACHING caches each site's leaf metadata instead; COMPRESSING
+    # and FIXING_FLOAT apply to the host-collective payloads on the DCN
+    # path. `comm_filters` (off by default) turns them on; the older
+    # `msg_compression` / `fixed_bytes` knobs are narrower per-call-site
+    # switches that predate the chain (see docs/comm.md).
     # bounded staleness: max device steps in flight. Single-host process()
     # gates BEFORE dispatch (the reference parses the next minibatch while
     # steps fly, async_sgd.h:81), so 0 and 1 behave identically — device
@@ -121,6 +125,12 @@ class Config:
     msg_compression: bool = False  # zlib-compress host-collective payloads
     fixed_bytes: int = 1
     tail_feature_freq: int = 0
+    # communication filter chain (parallel/filters.py): comma set from
+    # {key_caching, fixing_float, compressing}; "" = chain off, every
+    # host collective runs the raw unfiltered transport.
+    comm_filters: str = ""
+    comm_quant_bits: int = 8          # FIXING_FLOAT code width, in [2, 16]
+    comm_compress_min_bytes: int = 1024  # COMPRESSING skips smaller leaves
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100

@@ -304,6 +304,92 @@ def bench_e2e_text(path: str) -> dict:
                 ingest_piped / max(ingest_serial, 1e-9), 3)}
 
 
+def bench_tile_online(path: str) -> dict:
+    """The ISSUE-5 comparison: the SAME criteo text rows through the
+    three runtime routes — (a) the gather/scatter SparseBatch path
+    (tile_online=off, text_dense=off), (b) the online tile-encode path
+    (tile_online=on: fold + tile-group on the feed's prep workers, MXU
+    tile step on device), (c) the same rows pre-converted to a crec2
+    file and replayed. (b)/(a) is what online encoding buys a streaming
+    format; (c)/(b) is what pre-conversion still buys on top (it should
+    approach 1.0 when the encode stage hides behind device compute —
+    the residual is the reported encode-stall fraction)."""
+    import jax
+
+    def timed(app):
+        app.feed_stats = {"feed_stall": 0.0, "feed_batches": 0,
+                          "ring_max": 0}
+        app.timer.totals.clear()
+        app.timer.counts.clear()
+        t0 = time.perf_counter()
+        prog = app.process(path_of[app], 0, 1)
+        rows = prog.num_ex + app.flush_metrics().num_ex
+        jax.block_until_ready(app.store.slots)
+        float(np.asarray(app.store.slots[0, 0]))
+        elapsed = time.perf_counter() - t0
+        return rows / elapsed, elapsed
+
+    path_of: dict = {}
+    out: dict = {}
+
+    def run(variant, cfg_kwargs, data_path):
+        app = make_app(dict(max_delay=MAX_DELAY, num_buckets=NUM_BUCKETS,
+                            cache_device=False, lr_eta=0.1, disp_itv=1e12,
+                            **cfg_kwargs))
+        path_of[app] = data_path
+        app.process(data_path, 0, 1)       # compile + transport warm
+        rate, elapsed = timed(app)
+        out[f"{variant}_ex_per_sec"] = rate
+        return app, elapsed
+
+    # (a) scatter runtime path — the pre-PR route for any text stream
+    run("scatter", dict(train_data=path, data_format="criteo",
+                        text_dense=False, tile_online="off"), path)
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+    # (b) online tile encode (forced: `auto` needs the TPU backend)
+    app, elapsed = run("online", dict(train_data=path,
+                                      data_format="criteo",
+                                      tile_online="on"), path)
+    enc = app.timer.totals.get("encode", 0.0)
+    enc_stall = app.timer.totals.get("encode_stall", 0.0)
+    out["encode_sec"] = enc
+    out["encode_stall_frac"] = enc_stall / max(elapsed, 1e-9)
+    out["online_vs_scatter_speedup"] = (
+        out["online_ex_per_sec"] / max(out["scatter_ex_per_sec"], 1e-9))
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+    # (c) the same rows pre-converted to crec2 (the throughput ceiling):
+    # stream the text through the parser once, unpack the packed v1
+    # blocks, and append the real rows to a writer — identical hashed
+    # keys, so (b) and (c) run bit-identical device blocks
+    from wormhole_tpu.data.crec import (CRec2Writer, CRecInfo, PAD_LABEL,
+                                        TextCRecFeed, unpack_block)
+    c2 = path + ".conv.crec2"
+    feed = TextCRecFeed(path, text_fmt="criteo", nnz=CRITEO_NNZ,
+                        device_put=lambda x: x, workers=2)
+    with CRec2Writer(c2, nnz=CRITEO_NNZ, nb=NUM_BUCKETS) as w:
+        for _dev, packed, _rows in feed:
+            src = CRecInfo(nnz=CRITEO_NNZ,
+                           block_rows=packed.nbytes // (CRITEO_NNZ * 4 + 1),
+                           total_rows=0)
+            keys, labels = unpack_block(packed, src)
+            real = labels != PAD_LABEL
+            w.append(keys[real], labels[real])
+    try:
+        run("crec2", dict(train_data=c2, data_format="crec2"), c2)
+        out["crec2_vs_online_speedup"] = (
+            out["crec2_ex_per_sec"] / max(out["online_ex_per_sec"], 1e-9))
+    finally:
+        try:
+            os.remove(c2)
+        except OSError:
+            pass
+    return out
+
+
 def _median_window(fn, repeats=5):
     times = []
     for _ in range(repeats):
@@ -893,9 +979,10 @@ def bench_scale_curve(workdir: str, rng) -> list:
 # file / the text file are tagged so a filtered run only builds what it
 # uses.
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
-          "device_fm", "device_wide_deep", "channel_ratios",
-          "device_sparse", "device_dense_apply", "scale_curve",
-          "comm_filters", "kmeans", "lbfgs", "gbdt"]
+          "tile_online", "device_fm", "device_wide_deep",
+          "channel_ratios", "device_sparse", "device_dense_apply",
+          "scale_curve", "comm_filters", "kmeans", "lbfgs", "gbdt"]
+_TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
 _CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
@@ -1002,6 +1089,12 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
             k: (round(v, 1) if isinstance(v, float)
                 and not k.endswith("speedup") else v)
             for k, v in text.items()}
+    if "tile_online" in results:
+        extra["tile_online_text_stream"] = {
+            k: (round(v, 1) if isinstance(v, float)
+                and k.endswith("ex_per_sec")
+                else round(v, 4) if isinstance(v, float) else v)
+            for k, v in results["tile_online"].items()}
     if telemetry:
         extra["telemetry"] = telemetry
     return {
@@ -1076,7 +1169,7 @@ def main(argv=None) -> None:
     text_path = os.path.join(workdir, "bench.criteo")
     if any(p in _CREC2_PHASES for p in sel):
         write_crec2(crec2_path, E2E_ROWS, rng)
-    if "e2e_text" in sel:
+    if any(p in _TEXT_PHASES for p in sel):
         write_criteo_text(text_path, TEXT_ROWS, rng)
 
     stores_box: dict = {}
@@ -1094,6 +1187,7 @@ def main(argv=None) -> None:
                                                  stores()["scalar"]),
         "e2e_stream": lambda: bench_e2e_stream(crec2_path),
         "e2e_text": lambda: bench_e2e_text(text_path),
+        "tile_online": lambda: bench_tile_online(text_path),
         "device_fm": lambda: bench_device_fm(crec2_path, stores()["fm"]),
         "device_wide_deep": lambda: bench_device_wide_deep(
             crec2_path, stores()["wd"]),

@@ -308,6 +308,24 @@ def default_cap(nnz: int, nb: int) -> int:
     return max(128, int(-(-(mean + 3 * mean ** 0.5) // 128)) * 128)
 
 
+def encode_tile_block(keys: np.ndarray, nb: int, spec,
+                      ovf_cap: int) -> Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray, int]:
+    """One keys grid -> crec2 block operands: fold the real keys of a
+    ``(block_rows, nnz)`` u32 grid (SENTINEL_KEY empties) to hashed
+    buckets and tile-group them. Returns ``(pw, ovf_b, ovf_r, n_ovf)``
+    with fixed-``ovf_cap`` overflow arrays (tilemm.encode_block_capped
+    contract). THE single encoder entry: the crec2 writer and the online
+    tile-encode feed both call it, which is what makes an online-encoded
+    block bit-identical to the same rows pre-converted to a crec2
+    file."""
+    from wormhole_tpu.data.hashing import fold_keys32
+    from wormhole_tpu.ops.tilemm import encode_block_capped
+    rr, cc = np.nonzero(keys != SENTINEL_KEY)
+    buckets = fold_keys32(keys[rr, cc], nb)
+    return encode_block_capped(buckets, rr.astype(np.int64), spec, ovf_cap)
+
+
 class CRec2Writer:
     """Stream (keys, labels) rows into tile-grouped crec2 blocks.
 
@@ -352,22 +370,15 @@ class CRec2Writer:
                 self._flush_block(self.block_rows)
 
     def _flush_block(self, rows: int) -> None:
-        from wormhole_tpu.data.hashing import fold_keys32
-        from wormhole_tpu.ops.tilemm import encode_block
         keys = self._buf_keys
         keys[rows:] = SENTINEL_KEY
         self._buf_labels[rows:] = PAD_LABEL
-        rr, cc = np.nonzero(keys != SENTINEL_KEY)
-        buckets = fold_keys32(keys[rr, cc], self.nb)
-        pw, ovb, ovr = encode_block(buckets, rr.astype(np.int64),
-                                    self.spec)
-        if len(ovb) > self.ovf_cap:
+        pw, ob, orow, n_ovf = encode_tile_block(keys, self.nb, self.spec,
+                                                self.ovf_cap)
+        if n_ovf > self.ovf_cap:
             raise ValueError(
-                f"{self.path}: block overflow {len(ovb)} > ovf_cap "
+                f"{self.path}: block overflow {n_ovf} > ovf_cap "
                 f"{self.ovf_cap} — skewed keys; raise ovf_cap or nb")
-        ob = np.full(self.ovf_cap, 0xFFFFFFFF, np.uint32)
-        orow = np.zeros(self.ovf_cap, np.uint32)
-        ob[:len(ovb)], orow[:len(ovr)] = ovb, ovr
         self._f.write(pw.tobytes())
         self._f.write(self._buf_labels.tobytes())
         self._f.write(ob.tobytes())
@@ -764,3 +775,195 @@ class TextCRecFeed(PackedFeed):
             return asm(chunk)
 
         return source(), prep, fold, None
+
+
+# ---------------------------------------------------------------------------
+# online tile encoding: stream ANY v1-block source through the crec2 tile
+# step without a pre-converted file (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+# runtime overflow headroom per online-encoded block. Unlike the writer
+# (which can reject skew and ask for a bigger ovf_cap), the runtime path
+# falls back to the scatter step for a block whose overflow exceeds this
+# — so the value only trades a little device transfer width against
+# fallback frequency.
+ONLINE_OVF_CAP = 1024
+
+
+def online_info(nnz: int, src_rows: int, nb: int,
+                ovf_cap: int = ONLINE_OVF_CAP) -> CRec2Info:
+    """Tile geometry for online-encoding a stream of ``src_rows``-row v1
+    blocks into ``nb`` buckets: the subblock count rounds the source
+    block up to a multiple of RSUB (extra rows ride as padding), cap is
+    the same mean+3o default the writer uses. Raises ValueError (via
+    ``.spec``) exactly where the tilemm limits would reject a writer
+    with the same geometry — callers probe admissibility by constructing
+    the spec."""
+    from wormhole_tpu.ops.tilemm import RSUB
+    subblocks = max(-(-src_rows // RSUB), 1)
+    return CRec2Info(nnz=nnz, block_rows=subblocks * RSUB, total_rows=0,
+                     nb=nb, subblocks=subblocks,
+                     cap=default_cap(nnz, nb), ovf_cap=ovf_cap)
+
+
+class TileOnlineFeed:
+    """Online tile-encode stage: chain a v1-block source feed (PackedFeed
+    over a crec file, or TextCRecFeed over text) into a DeviceFeed whose
+    prep workers run fold+tile-group (``encode_tile_block``) per block —
+    the CRec2Writer's expensive host work, relocated onto the PR 1
+    parallel pad workers so it hides behind device compute. Yields the
+    same ``(device_block_dict, host_labels, rows)`` triples the crec2
+    PackedFeed path produces, so the consumer runs the MXU tile step on
+    a stream that never touched a crec2 file (the worker-side
+    pre-encoding move of Li et al.'s parameter server, done in the feed
+    instead of a file format).
+
+    Cap-overflow fallback: a block whose COO overflow exceeds
+    ``info.ovf_cap`` (skew the writer would reject, but runtime data has
+    no writer) is instead localized into a whole-block SparseBatch and
+    yielded as-is — the consumer routes it through the audited scatter
+    step and counts it (``fallback_blocks``). Never an error.
+
+    ``inner`` must yield ``(dev, packed_v1, rows)`` with an identity
+    device_put (its packed v1 bytes stay on host for the encode);
+    ``workers=0`` runs the encode inline on the consumer thread — the
+    determinism oracle, same contract as DeviceFeed."""
+
+    def __init__(self, inner, info: CRec2Info, *, workers: int = 2,
+                 depth: int = 2, device_put=None, cache: bool = False,
+                 name: str = "tile-encode"):
+        self.inner = inner
+        self.info = info
+        self.workers = workers
+        self.depth = depth
+        self.name = name
+        self._device_put = device_put
+        self.put_time = 0.0
+        self.fallback_blocks = 0
+        self._cache: Optional[list] = [] if cache else None
+        self._cache_full = False
+        self._pipe = None
+        # per-feed scratch is NOT shared with prep workers — each encode
+        # call allocates its own grid (thread-safe by construction)
+        self._src_rows = getattr(inner, "block_rows", None)
+
+    @property
+    def bytes_read(self) -> int:
+        return self.inner.bytes_read
+
+    def __iter__(self):
+        if self._cache_full:
+            yield from self._cache
+            return
+        yield from self._stream()
+
+    def _stream(self):
+        try:
+            for item in self._pipelined():
+                if self._cache is not None:
+                    self._cache.append(item)
+                yield item
+            if self._cache is not None:
+                self._cache_full = True
+        finally:
+            if self._cache is not None and not self._cache_full:
+                # partial iteration must not leave a half cache that a
+                # retry would extend into duplicated blocks (same
+                # contract as PackedFeed._stream)
+                self._cache = []
+
+    def _encode(self, item, _ctx):
+        """Worker-side stage: v1 packed block -> crec2 typed dict, or a
+        SparseBatch when the block's overflow exceeds the cap."""
+        packed, rows = item
+        info = self.info
+        R, nnz = info.block_rows, info.nnz
+        src = CRecInfo(nnz=nnz, block_rows=self._src(packed),
+                       total_rows=0)
+        keys, labels = unpack_block(packed, src)
+        if src.block_rows == R:
+            kgrid = keys
+            lab = labels.copy()
+        else:
+            # source blocks shorter than the tile block: pad rows up —
+            # this is what makes ANY source block_rows admissible
+            kgrid = np.full((R, nnz), SENTINEL_KEY, np.uint32)
+            kgrid[:src.block_rows] = keys
+            lab = np.full(R, PAD_LABEL, np.uint8)
+            lab[:src.block_rows] = labels
+        pw, ob, orow, n_ovf = encode_tile_block(kgrid, info.nb, info.spec,
+                                                info.ovf_cap)
+        if n_ovf > info.ovf_cap:
+            from wormhole_tpu.data.feed import bucket_block_batch
+            from wormhole_tpu.data.hashing import fold_keys32
+            valid = kgrid != SENTINEL_KEY
+            grid = np.zeros(kgrid.shape, np.int64)
+            grid[valid] = fold_keys32(kgrid[valid], info.nb)
+            return bucket_block_batch(grid, valid, lab), lab, rows
+        return ({"pw": pw, "labels": lab, "ovf_b": ob, "ovf_r": orow},
+                lab, rows)
+
+    def _src(self, packed) -> int:
+        if self._src_rows is None:
+            self._src_rows = packed.nbytes // (self.info.nnz * 4 + 1)
+        return self._src_rows
+
+    def _transfer(self, res):
+        import time as _time
+        import jax
+        payload, lab, rows = res
+        from wormhole_tpu.data.feed import SparseBatch
+        if isinstance(payload, SparseBatch):
+            # single transfer thread: plain increment is safe
+            self.fallback_blocks += 1
+        put = self._device_put or jax.device_put
+        t0 = _time.perf_counter()
+        dev = put(payload)
+        self.put_time += _time.perf_counter() - t0
+        return dev, lab, rows
+
+    def _pipelined(self):
+        from wormhole_tpu.data.pipeline import DeviceFeed
+
+        def source():
+            for _dev, packed, rows in self.inner:
+                yield packed, rows
+
+        feed = DeviceFeed(source(), self._encode, workers=self.workers,
+                          ring_depth=self.depth, transfer=self._transfer,
+                          name=self.name, prep_label="encode")
+        self._pipe = feed
+        yield from feed
+
+    def drain_pipe_stats(self, timer, prefix: str = "") -> Optional[dict]:
+        """Merged two-layer snapshot in PackedFeed's key scheme plus the
+        encode stage: ``prep`` stays the inner read/assembly work (the
+        consumer's ``read`` timer line), ``encode``/``encode_stall`` are
+        the outer pool's busy seconds and the in-order wait on it (the
+        time tile encoding actually delayed the stream)."""
+        inner_snap = (self.inner.drain_pipe_stats(None)
+                      if hasattr(self.inner, "drain_pipe_stats") else None)
+        pipe, self._pipe = self._pipe, None
+        snap = pipe.drain_stats(None) if pipe is not None else None
+        if snap is None:
+            return None
+        inner_snap = inner_snap or {}
+        out = {
+            "parse": inner_snap.get("parse", 0.0),
+            "prep": inner_snap.get("prep", 0.0),
+            "prep_stall": inner_snap.get("prep_stall", 0.0),
+            "put": snap["put"],
+            "put_stall": inner_snap.get("put_stall", 0.0),
+            "encode": snap["prep"],
+            "encode_stall": snap["put_stall"],
+            "consume_stall": snap["consume_stall"],
+            "batches": snap["batches"],
+            "ring_max": snap["ring_max"],
+        }
+        if timer is not None:
+            n = max(out["batches"], 1)
+            for k in ("parse", "put", "encode"):
+                timer.add(prefix + k, out[k], n)
+            for k in ("prep_stall", "encode_stall", "consume_stall"):
+                timer.add(prefix + k, out[k], n)
+        return out

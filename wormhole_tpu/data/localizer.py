@@ -74,3 +74,19 @@ class Localizer:
         )
         return Localized(uniq_keys=uniq, block=local,
                          freq=freq.astype(np.int32))
+
+
+def localize_bucket_grid(buckets: np.ndarray,
+                         valid: np.ndarray) -> Tuple[np.ndarray,
+                                                     np.ndarray]:
+    """Localize an already-folded fixed-nnz bucket grid: global bucket
+    ids ``(rows, nnz)`` plus a validity mask → (sorted unique buckets,
+    local-id grid with 0 on invalid slots). The class above localizes
+    ragged CSR RowBlocks before the fold; the online tile-encode spill
+    path (data/crec.TileOnlineFeed) arrives post-fold on the crec
+    fixed-width grid, so the unique/inverse pass maps the grid
+    directly — same sorted-unique contract as ``Localized.uniq_keys``."""
+    uniq, inv = np.unique(buckets[valid], return_inverse=True)
+    cols = np.zeros(buckets.shape, np.int64)
+    cols[valid] = inv
+    return uniq, cols

@@ -101,6 +101,11 @@ class DeviceFeed:
     on_close: called exactly once when iteration ends for any reason
              (exhaustion, error, abandonment) — close per-thread file
              handles here.
+    prep_label: display name for the prep stage in trace spans and the
+             ``drain_stats`` timer merge (default: ``prep`` spans, the
+             historical ``pad`` timer key). The online tile-encode feed
+             passes ``"encode"`` so its worker stage shows up as what it
+             is instead of as padding.
     """
 
     def __init__(self, source: Iterable[Any],
@@ -111,7 +116,8 @@ class DeviceFeed:
                  transfer: Optional[Callable[[Any], Any]] = None,
                  bytes_read: Optional[Callable[[], int]] = None,
                  on_close: Optional[Callable[[], None]] = None,
-                 name: str = "feed") -> None:
+                 name: str = "feed",
+                 prep_label: Optional[str] = None) -> None:
         if ring_depth < 1:
             raise ValueError("ring_depth must be >= 1")
         self.source = source
@@ -124,6 +130,7 @@ class DeviceFeed:
         self._bytes_read = bytes_read
         self._on_close = on_close
         self.name = name
+        self.prep_label = prep_label
         self._lock = threading.Lock()
         self._busy = {"parse": 0.0, "prep": 0.0, "put": 0.0}
         self._stall = {"parse": 0.0, "prep": 0.0, "put": 0.0,
@@ -142,7 +149,9 @@ class DeviceFeed:
         # transfer / consumer as separate tracks with stage overlap
         if trace.enabled():
             suffix = "_stall" if table is self._stall else ""
-            trace.complete(f"{self.name}:{key}{suffix}",
+            label = (self.prep_label
+                     if key == "prep" and self.prep_label else key)
+            trace.complete(f"{self.name}:{label}{suffix}",
                            time.monotonic() - dt, dt, cat="feed")
 
     def stats(self) -> dict:
@@ -173,11 +182,12 @@ class DeviceFeed:
             self._ring_max = 0
         if timer is not None:
             n = max(snap["batches"], 1)
+            lbl = self.prep_label or "pad"
             timer.add(prefix + "parse", snap["parse"], n)
-            timer.add(prefix + "pad", snap["prep"], n)
+            timer.add(prefix + lbl, snap["prep"], n)
             timer.add(prefix + "put", snap["put"], n)
             timer.add(prefix + "feed_stall", snap["consume_stall"], n)
-            timer.add(prefix + "pad_stall", snap["prep_stall"], n)
+            timer.add(prefix + f"{lbl}_stall", snap["prep_stall"], n)
             timer.add(prefix + "put_stall", snap["put_stall"], n)
         return snap
 

@@ -93,6 +93,8 @@ class AsyncSGD:
         if cfg.test_data and not cfg.pred_out:
             # fail at construction, not after hours of training
             raise ValueError("test_data set but pred_out empty")
+        from wormhole_tpu.utils.config import check_choice
+        check_choice("tile_online", cfg.tile_online, ("auto", "on", "off"))
         self.localizer = Localizer(num_buckets=cfg.num_buckets,
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()
@@ -236,7 +238,7 @@ class AsyncSGD:
         reference evaluates AUC over the complete pass, evaluation.h:38-68,
         not a mean of per-minibatch AUCs)."""
         if self.cfg.data_format in ("crec", "crec2") \
-                or self._text_dense():
+                or self._text_dense() or self._tile_online():
             return self._process_crec(file, part, nparts, kind, pooled)
         cfg = self.cfg
         fs0 = dict(self.feed_stats)
@@ -342,6 +344,14 @@ class AsyncSGD:
         self.timer.add(pfx + "feed_stall", snap["consume_stall"], n)
         self.timer.add(pfx + "read_stall", snap["prep_stall"], n)
         self.timer.add(pfx + "put_stall", snap["put_stall"], n)
+        if "encode" in snap:
+            # online tile-encode stage (data/crec.TileOnlineFeed):
+            # encode_stall is the in-order transferrer waiting on the
+            # encode pool — the "is encoding the bottleneck?" signal
+            self.timer.add(pfx + "encode", snap["encode"], n)
+            self.timer.add(pfx + "encode_stall", snap["encode_stall"], n)
+            stall_c, _ = obs.metrics.encode_counters(self.obs.registry)
+            stall_c.inc(snap["encode_stall"])
         self.feed_stats["feed_stall"] += snap["consume_stall"]
         self.feed_stats["feed_batches"] += snap["batches"]
         self.feed_stats["ring_max"] = max(self.feed_stats["ring_max"],
@@ -366,11 +376,73 @@ class AsyncSGD:
                              "fixed crec row width)")
         return self.cfg.max_nnz
 
+    def _online_info(self, fmt: str, file: Optional[str]):
+        """Synthetic crec2 geometry for online-encoding this stream
+        (data/crec.online_info): crec v1 takes nnz/rows from the file
+        header, dense text from config. ``file=None`` is the geometry
+        probe used before a file is at hand — admission is bucket-count
+        driven, so nominal nnz/rows stand in."""
+        from wormhole_tpu.data.crec import online_info, read_header
+        from wormhole_tpu.ops.tilemm import RSUB
+        cfg = self.cfg
+        if fmt == "crec":
+            if file is None:
+                return online_info(1, RSUB, cfg.num_buckets)
+            src = read_header(file)
+            return online_info(src.nnz, src.block_rows, cfg.num_buckets)
+        return online_info(self._text_nnz(), cfg.text_block_rows,
+                           cfg.num_buckets)
+
+    def _tile_online(self, fmt: Optional[str] = None,
+                     file: Optional[str] = None) -> bool:
+        """Does this stream route through the online tile-encode path
+        (cfg.tile_online)? ``auto`` = TPU backend + a store with the
+        tile-step surface + single-process + tilemm-admissible geometry
+        — the scatter/dense paths stay the oracle and fallback, the
+        ``gbdt_hist_kernel`` gating pattern. ``on`` asserts
+        admissibility (raises with the reason — the parity-test mode);
+        ``off`` never routes. crec2 files are pre-encoded and ignore
+        the knob."""
+        cfg = self.cfg
+        mode = cfg.tile_online
+        fmt = fmt or cfg.data_format
+        if mode == "off" or fmt == "crec2":
+            return False
+        why = None
+        if fmt not in ("crec", "criteo", "adfea"):
+            why = (f"format {fmt!r} is not a binary-feature streaming "
+                   "format (crec/criteo/adfea)")
+        elif not hasattr(self.store, "tile_train_step"):
+            why = (f"store {type(self.store).__name__} has no tile "
+                   "step surface")
+        elif jax.process_count() > 1:
+            why = "multi-process runs keep the scatter/dense paths"
+        else:
+            try:
+                self._online_info(fmt, file).spec
+            except ValueError as e:
+                why = f"tilemm limits reject the geometry: {e}"
+        if why is not None:
+            if mode == "on":
+                raise ValueError(f"tile_online=on but {why}")
+            return False
+        return mode == "on" or jax.default_backend() == "tpu"
+
     def _make_feed(self, file: str, part: int, nparts: int, fmt: str,
-                   device_put=None, cache: bool = False):
-        from wormhole_tpu.data.crec import PackedFeed, TextCRecFeed
+                   device_put=None, cache: bool = False, tile_info=None):
+        from wormhole_tpu.data.crec import (PackedFeed, TextCRecFeed,
+                                            TileOnlineFeed)
         workers = self.cfg.pipeline_workers
         depth = max(self.cfg.pipeline_ring, 3 if workers == 0 else 1)
+        if tile_info is not None and fmt != "crec2":
+            # online tile encoding: the v1/text source feed keeps its
+            # packed blocks on host (identity put) and the TileOnlineFeed
+            # workers fold+tile-group them before the device transfer
+            inner = self._make_feed(file, part, nparts, fmt,
+                                    device_put=lambda x: x)
+            return TileOnlineFeed(inner, tile_info, workers=workers,
+                                  depth=depth, device_put=device_put,
+                                  cache=cache)
         if fmt in ("crec", "crec2"):
             return PackedFeed(file, part, nparts, fmt=fmt, cache=cache,
                               device_put=device_put, workers=workers,
@@ -381,16 +453,19 @@ class AsyncSGD:
                             cache=cache, device_put=device_put,
                             workers=workers, depth=depth)
 
-    def _feed(self, file: str, part: int, nparts: int, fmt: str):
+    def _feed(self, file: str, part: int, nparts: int, fmt: str,
+              tile_info=None):
         """Feed per (file, part), kept across data passes so cache_device
         replays HBM-resident blocks instead of re-streaming over the host
         interconnect."""
         if not self.cfg.cache_device:
-            return self._make_feed(file, part, nparts, fmt)
-        key = (file, part, nparts, fmt)
+            return self._make_feed(file, part, nparts, fmt,
+                                   tile_info=tile_info)
+        key = (file, part, nparts, fmt, tile_info is not None)
         feed = self._feeds.get(key) if hasattr(self, "_feeds") else None
         if feed is None:
-            feed = self._make_feed(file, part, nparts, fmt, cache=True)
+            feed = self._make_feed(file, part, nparts, fmt, cache=True,
+                                   tile_info=tile_info)
             if not hasattr(self, "_feeds"):
                 self._feeds = {}
             self._feeds[key] = feed
@@ -466,6 +541,8 @@ class AsyncSGD:
         from wormhole_tpu.ops.metrics import auc_from_hist
         cfg = self.cfg
         fmt = cfg.data_format
+        online = fmt != "crec2" and self._tile_online(fmt, file)
+        tile = fmt == "crec2" or online
         if fmt == "crec2":
             if not hasattr(self.store, "tile_train_step"):
                 raise ValueError(
@@ -478,6 +555,11 @@ class AsyncSGD:
                     f"but config says {cfg.num_buckets} (the tile grouping "
                     "is bucket-count specific)")
             lab_off = 0  # crec2 blocks are typed dicts; labels ride as-is
+        elif online:
+            # online tile encoding: the feed's workers turn v1/text
+            # blocks into crec2-typed blocks; host labels ride separately
+            info = self._online_info(fmt, file)
+            lab_off = 0
         else:
             if not hasattr(self.store, "dense_train_step"):
                 raise ValueError(
@@ -497,21 +579,48 @@ class AsyncSGD:
         max_delay = cfg.max_delay if kind == TRAIN else 1 << 30
         tau_cap = float(max(cfg.max_delay - 1, 0))
         inflight: deque = deque()
-        # crec2-train metrics accumulate ON DEVICE (store.fetch_metrics;
+        # tile-train metrics accumulate ON DEVICE (store.fetch_metrics;
         # the app-level deferred window survives across parts); eval/v1
         # metrics ride per-step vectors in the part-local pending list
-        acc_metrics = fmt == "crec2" and kind == TRAIN
+        acc_metrics = tile and kind == TRAIN
         pending: list = []
+        # overflow-fallback scatter steps (online blocks whose COO spill
+        # exceeded ovf_cap): their metrics ride the sparse-path layout
+        spill: list = []
         local = Progress()
+
+        def drain_spill() -> None:
+            """Resolve overflow-fallback steps: sparse-path metric tuple
+            layout — [objv, num_ex, auc, acc, wdelta2|margin]."""
+            if not spill:
+                return
+            fetched = jax.device_get([s[0] for s in spill])
+            for (_m, labels_u8), metrics in zip(spill, fetched):
+                local.objv += float(metrics[0])
+                local.num_ex += int(metrics[1])
+                local.count += 1
+                local.auc += float(metrics[2])
+                local.acc += float(metrics[3])
+                if kind == TRAIN:
+                    local.wdelta2 += float(metrics[4])
+                elif pooled is not None and labels_u8 is not None:
+                    margin = np.asarray(metrics[4])
+                    real = labels_u8 != 255
+                    pooled.append((margin[real],
+                                   np.minimum(labels_u8[real], 1)
+                                   .astype(np.float32),
+                                   np.ones(int(real.sum()), np.float32)))
+            spill.clear()
 
         def drain_pending(final: bool = True) -> None:
             """Harvest metrics with minimal host<->device round trips —
             per-leaf fetches cost one round trip each, which dominates
             the steady-state loop on a high-latency transport (the axon
-            tunnel; round-3 finding). crec2-train drains the on-device
+            tunnel; round-3 finding). tile-train drains the on-device
             accumulator (async ticket when ``final`` is False, so the
             device never stalls mid-stream); eval/v1 paths batch-fetch
             their per-step metric vectors."""
+            drain_spill()
             if acc_metrics:
                 self._drain_crec2_train(local, final)
                 return
@@ -522,7 +631,7 @@ class AsyncSGD:
                 local.objv += float(metrics[0])
                 local.num_ex += int(metrics[1])
                 local.count += 1
-                if fmt == "crec2":
+                if tile:
                     local.acc += float(metrics[2])
                     local.auc += auc_from_hist(metrics[3], metrics[4])
                     margin_ix = 5  # eval: margins ride in slot 5
@@ -544,10 +653,12 @@ class AsyncSGD:
                 self._display(local)
 
         def harvest(item) -> None:
-            m = item[0]
+            m, labels, is_spill = item
             jax.block_until_ready(m[0] if isinstance(m, tuple) else m)
-            if not acc_metrics:
-                pending.append(item)
+            if is_spill:
+                spill.append((m, labels))
+            elif not acc_metrics:
+                pending.append((m, labels))
             if kind == TRAIN and self.reporter.due():
                 # mid-stream display drain: non-final for the accumulator
                 # path — a blocking fetch of the just-started window costs
@@ -562,7 +673,7 @@ class AsyncSGD:
             return host[lab_off:lab_off + info.block_rows].copy()
 
         has_mesh_step = hasattr(
-            self.store, "tile_train_step_mesh" if fmt == "crec2"
+            self.store, "tile_train_step_mesh" if tile
             else "dense_train_step_mesh") \
             and getattr(self.store, "rt", None) is not None
         # text formats ride the dense mesh step; the linear, FM and
@@ -571,9 +682,11 @@ class AsyncSGD:
         # single-device tile path on its own placement
         if self.rt.mesh.size > 1 and has_mesh_step:
             return self._process_crec_mesh(file, part, nparts, kind,
-                                           pooled, info, local, fmt)
+                                           pooled, info, local, fmt,
+                                           online)
         pfx = "" if kind == TRAIN else "eval_"
-        feed = self._feed(file, part, nparts, fmt)
+        feed = self._feed(file, part, nparts, fmt,
+                          tile_info=info if online else None)
         put_before = feed.put_time
         # snapshot BEFORE iterating: the feed flips _cache_full as its
         # stream exhausts, which is mid-way through THIS part
@@ -591,34 +704,49 @@ class AsyncSGD:
                 while len(inflight) > max(max_delay - 1, 0):
                     harvest(inflight.popleft())
             with self.timer.scope(pfx + "dispatch"):
-                if fmt == "crec2":
+                if tile and isinstance(dev, dict):
                     if kind == TRAIN:
                         m = self.store.tile_train_step(
                             dev, info,
                             tau=min(float(len(inflight)), tau_cap))
                         self._crec_count += 1
-                        inflight.append((m, None))
+                        inflight.append((m, None, False))
                     else:
                         m = self.store.tile_eval_step(dev, info)
-                        inflight.append((m, _labels_of(host)))
+                        inflight.append((m, _labels_of(host), False))
+                elif tile:
+                    # online overflow fallback: the block arrived as a
+                    # SparseBatch — audited scatter step, counted
+                    obs.metrics.encode_counters(
+                        self.obs.registry)[1].inc(1)
+                    if kind == TRAIN:
+                        m = self.store.train_step(
+                            dev, tau=min(float(len(inflight)), tau_cap))
+                        inflight.append((m, None, True))
+                    else:
+                        m = self.store.eval_step(dev)
+                        inflight.append((m, _labels_of(host), True))
                 elif kind == TRAIN:
                     m = self.store.dense_train_step(
                         dev, info.block_rows, info.nnz,
                         tau=min(float(len(inflight)), tau_cap))
-                    inflight.append((m, None))
+                    inflight.append((m, None, False))
                 else:
                     m = self.store.dense_eval_step(dev, info.block_rows,
                                                    info.nnz)
-                    inflight.append((m, _labels_of(host)))
+                    inflight.append((m, _labels_of(host), False))
         with self.timer.scope(pfx + "wait"):
             # no per-item block_until_ready here: drain_pending's
             # device fetch synchronizes, and each block_until_ready is a
             # full round trip on a tunneled transport
             while inflight:
-                item = inflight.popleft()
-                if not acc_metrics:
-                    pending.append(item)
+                m, labels, is_spill = inflight.popleft()
+                if is_spill:
+                    spill.append((m, labels))
+                elif not acc_metrics:
+                    pending.append((m, labels))
             if acc_metrics and replay:
+                drain_spill()
                 # HBM-resident replay: leave the window deferred — the
                 # end-of-part fetch is a round trip per part; the
                 # caller's flush_metrics()/disp_itv drains it — but bound
@@ -635,13 +763,17 @@ class AsyncSGD:
     def _process_crec_mesh(self, file: str, part: int, nparts: int,
                            kind: str, pooled: Optional[list],
                            info, local: Progress,
-                           fmt: str = "crec2") -> Progress:
+                           fmt: str = "crec2",
+                           online: bool = False) -> Progress:
         """crec/crec2 over a multi-device mesh: feed blocks in groups of
         ``data_axis_size`` (stacked on a leading axis; short tails pad
         with all-PAD blocks) through the shard_map step — crec2 runs the
         tile step (model axis shards bucket tiles), crec v1 the mesh
         dense-apply step (model axis range-shards the folded table); data
-        axis shards blocks either way."""
+        axis shards blocks either way. ``online`` routes a v1/text stream
+        through the online tile encoder (same typed blocks as crec2);
+        encode-overflow blocks arrive as SparseBatch and run through the
+        scatter step synchronously, outside the D-grouping."""
         from wormhole_tpu.data.crec import PackedFeed
         from wormhole_tpu.ops.metrics import auc_from_hist
         if jax.process_count() > 1:
@@ -651,17 +783,19 @@ class AsyncSGD:
             raise RuntimeError(
                 f"call run()/run_multihost for multi-process {fmt} — "
                 "process() is single-process only")
+        is_tile = fmt == "crec2" or online
         D = self.rt.data_axis_size
         pfx = "" if kind == TRAIN else "eval_"
         # no-op device_put: the mesh step jits host arrays straight onto
         # their (data, model)-sharded layout
         feed = self._make_feed(file, part, nparts, fmt,
-                               device_put=lambda x: x)
+                               device_put=lambda x: x,
+                               tile_info=info if online else None)
         group: list = []
 
         # shared pad arrays — building them per dispatch would allocate
         # megabytes of throwaway uint16 per step in the hot loop
-        if fmt == "crec2":
+        if is_tile:
             spec = info.spec
             ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF,
                                 np.uint32)
@@ -692,7 +826,7 @@ class AsyncSGD:
         def dispatch(views_list):
             while len(views_list) < D:
                 views_list.append(pad_block())
-            if fmt == "crec2":
+            if is_tile:
                 blocks = {k: np.stack([v[k] for v in views_list])
                           for k in ("pw", "labels")}
                 blocks["ovf_b"] = np.stack(
@@ -703,7 +837,7 @@ class AsyncSGD:
                 blocks = np.stack(views_list)
             with self.timer.scope(pfx + "dispatch"):
                 if kind == TRAIN:
-                    if fmt == "crec2":
+                    if is_tile:
                         self.store.tile_train_step_mesh(blocks, info)
                     else:
                         self.store.dense_train_step_mesh(
@@ -715,7 +849,7 @@ class AsyncSGD:
                             drain_pending(final=False)
                 else:
                     m = (self.store.tile_eval_step_mesh(blocks, info)
-                         if fmt == "crec2" else
+                         if is_tile else
                          self.store.dense_eval_step_mesh(
                              blocks, info.block_rows, info.nnz))
                     local.objv += float(np.asarray(m[0]))
@@ -728,7 +862,7 @@ class AsyncSGD:
                         margins = np.asarray(jax.device_get(m[5]))
                         from wormhole_tpu.data.crec import unpack_block
                         labs = np.concatenate(
-                            [v["labels"] if fmt == "crec2"
+                            [v["labels"] if is_tile
                              else unpack_block(v, info)[1]
                              for v in views_list])
                         real = labs != 255
@@ -737,7 +871,36 @@ class AsyncSGD:
                              np.minimum(labs[real], 1).astype(np.float32),
                              np.ones(int(real.sum()), np.float32)))
 
-        for dev, _host, _rows in feed:
+        def dispatch_spill(batch, labels_u8):
+            """Encode-overflow block: one synchronous scatter step (the
+            replicated-table sparse path) with its metrics folded into
+            ``local`` immediately — the on-device tile accumulator never
+            sees this block."""
+            obs.metrics.encode_counters(self.obs.registry)[1].inc(1)
+            with self.timer.scope(pfx + "dispatch"):
+                m = (self.store.train_step(batch, tau=0.0)
+                     if kind == TRAIN else self.store.eval_step(batch))
+            metrics = jax.device_get(m)
+            local.objv += float(metrics[0])
+            local.num_ex += int(metrics[1])
+            local.count += 1
+            local.auc += float(metrics[2])
+            local.acc += float(metrics[3])
+            if kind == TRAIN:
+                local.wdelta2 += float(metrics[4])
+            elif pooled is not None and labels_u8 is not None:
+                margin = np.asarray(metrics[4])
+                real = labels_u8 != 255
+                pooled.append((margin[real],
+                               np.minimum(labels_u8[real], 1)
+                               .astype(np.float32),
+                               np.ones(int(real.sum()), np.float32)))
+
+        for dev, host, _rows in feed:
+            if online and not isinstance(dev, dict):
+                # the online feed's host item is the labels-only array
+                dispatch_spill(dev, np.asarray(host))
+                continue
             group.append(dev)
             if len(group) == D:
                 dispatch(group)
@@ -1481,9 +1644,12 @@ class AsyncSGD:
         from the text formats — see data/hashing.py)."""
         # text_dense folds on device (mix32) only single-process;
         # run_multihost routes text through the sparse localize path
-        # (splitmix64) — the saved fold tag must follow the path that ran
+        # (splitmix64) — the saved fold tag must follow the path that ran.
+        # The online tile encoder folds on host with the same mix32
+        # (hashing.fold_keys32), so any stream it admits keeps that tag.
         return ("mix32" if self.cfg.data_format in ("crec", "crec2")
                 or (self._text_dense() and jax.process_count() == 1)
+                or self._tile_online()
                 else "splitmix64")
 
     def _store_io(self, op: str, path: str):

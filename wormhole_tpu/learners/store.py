@@ -325,7 +325,9 @@ class ShardedStore(TableCheckpoint):
             new_rows = handle.push(rows, grad,
                                    t.astype(jnp.float32), tau)
             delta = (new_rows - rows) * batch.key_mask[:, None]
-            slots = slots.at[batch.uniq_keys].add(          # push (scatter)
+            # scatter-fallback: uniq-key push, O(uniq) rows — the sparse
+            # step is the audited fallback for the online tile path
+            slots = slots.at[batch.uniq_keys].add(
                 delta.astype(slots.dtype))
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)
@@ -399,6 +401,8 @@ class ShardedStore(TableCheckpoint):
                 if not exact_dense:
                     dual = _nudge_zero_dual(dual, labels, row_mask)
                 contrib = (dual[:, None] * vf).reshape(-1)
+                # scatter-fallback: v1 dense-apply grad build (on-device
+                # fold; the tile path replaces this when admissible)
                 grad = jnp.zeros((nb,), jnp.float32).at[b].add(contrib)
                 s32 = slots.astype(jnp.float32)
                 new = masked_push(handle, s32, grad,
@@ -500,6 +504,8 @@ class ShardedStore(TableCheckpoint):
             if not exact_dense:
                 dual = _nudge_zero_dual(dual, labels, row_mask)
             contrib = (dual[:, None] * vf).reshape(-1)
+            # scatter-fallback: mesh v1 dense-apply grad build (shard-
+            # local fold; the mesh tile path replaces this)
             grad = jnp.zeros((nb_local,), jnp.float32).at[bl].add(contrib)
             grad = jax.lax.psum(grad, DATA_AXIS)
             new = masked_push(handle, s32, grad, t.astype(jnp.float32),
@@ -669,6 +675,7 @@ class ShardedStore(TableCheckpoint):
                 ovb, ovr = ovb_l[0], ovr_l[0]
                 valid, idx = shard_range_mask(ovb, off, nb_local)
                 wv = jnp.where(valid, w[idx], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 mg = mg.at[ovr.astype(jnp.int32)].add(wv)
             margin = (jax.lax.psum(mg, MODEL_AXIS) if have_model else mg)
             objv = objv_fn(margin, labels, row_mask)
@@ -685,6 +692,7 @@ class ShardedStore(TableCheckpoint):
             g = tilemm.backward_grad(pw1, dual, spec_local)
             if oc:
                 dv = jnp.where(valid, dual[ovr.astype(jnp.int32)], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 g = g.at[idx].add(dv)
             g = jax.lax.psum(g, DATA_AXIS)
             new = masked_push(handle, s32, g, t.astype(jnp.float32), tau,
@@ -804,6 +812,7 @@ class ShardedStore(TableCheckpoint):
             new_rows = handle.push(rows, grad, jnp.float32(0),
                                    jnp.float32(0), gsum_snap=snap)
             delta = (new_rows - rows) * key_mask[:, None]
+            # scatter-fallback: dt2 uniq-key push, O(uniq) rows
             return slots.at[uniq_keys].add(delta.astype(slots.dtype))
 
         return pull, push

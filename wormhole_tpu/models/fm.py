@@ -111,6 +111,8 @@ class FMStore(TableCheckpoint):
             new_rows = jnp.concatenate(
                 [w_new[:, None], v_new, cg_new], axis=1)
             delta = (new_rows - rows) * batch.key_mask[:, None]
+            # scatter-fallback: uniq-key push, O(uniq) rows — the sparse
+            # step is the audited fallback for the online tile path
             slots = slots.at[batch.uniq_keys].add(delta)
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)
@@ -288,6 +290,7 @@ class FMStore(TableCheckpoint):
                 ovb, ovr = ovb_l[0], ovr_l[0]
                 valid, idx = shard_range_mask(ovb, off, nb_local)
                 wv = jnp.where(valid[:, None], wpull[idx], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 pulls = pulls.at[ovr.astype(jnp.int32) % R].add(wv)
             pulls = (jax.lax.psum(pulls, MODEL_AXIS) if have_model
                      else pulls)
@@ -310,6 +313,7 @@ class FMStore(TableCheckpoint):
             if oc:
                 dv = jnp.where(valid[:, None],
                                dvals[ovr.astype(jnp.int32) % R], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 push = push.at[idx].add(dv)
             push = jax.lax.psum(push, DATA_AXIS)
             g_w = push[:, 0]

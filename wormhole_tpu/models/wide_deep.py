@@ -129,6 +129,8 @@ class WideDeepStore(TableCheckpoint):
             theta_new = theta - eta * g_theta
             new_rows = jnp.concatenate([theta_new, cg_new], axis=1)
             delta = (new_rows - rows) * batch.key_mask[:, None]
+            # scatter-fallback: uniq-key push, O(uniq) rows — the sparse
+            # step is the audited fallback for the online tile path
             slots = slots.at[batch.uniq_keys].add(delta)
 
             # dense AdaGrad
@@ -310,6 +312,7 @@ class WideDeepStore(TableCheckpoint):
                 ovb, ovr = ovb_l[0], ovr_l[0]
                 valid, idx = shard_range_mask(ovb, off, nb_local)
                 wv = jnp.where(valid[:, None], wpull[idx], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 pulls = pulls.at[ovr.astype(jnp.int32) % R].add(wv)
             pulls = (jax.lax.psum(pulls, MODEL_AXIS) if have_model
                      else pulls)
@@ -337,6 +340,7 @@ class WideDeepStore(TableCheckpoint):
             if oc:
                 dv = jnp.where(valid[:, None],
                                dvals[ovr.astype(jnp.int32) % R], 0.0)
+                # scatter-fallback: COO overflow spill, O(ovf_cap)
                 push = push.at[idx].add(dv)
             push = jax.lax.psum(push, DATA_AXIS)
             touched = push[:, 1 + k] > 0

@@ -374,3 +374,15 @@ def default_registry() -> Registry:
     if _DEFAULT is None:
         _DEFAULT = Registry()
     return _DEFAULT
+
+
+def encode_counters(reg: Optional[Registry] = None):
+    """The online tile-encode stage counters — single declaration site
+    (lint_knobs uniqueness contract), fetched per call so a cleared
+    default registry never strands stale Counter objects: seconds the
+    stream waited on the encode workers (beside the PR 1 feed stall
+    counters), and blocks whose COO overflow exceeded ``ovf_cap`` and
+    fell back to the audited scatter step."""
+    reg = reg if reg is not None else default_registry()
+    return (reg.counter("feed/encode_stall"),
+            reg.counter("feed/tile_fallback_blocks"))

@@ -162,6 +162,15 @@ class Config:
     # 0 = the serial feed path (every stage inline on the consumer).
     pipeline_workers: int = 2
     pipeline_ring: int = 2
+    # online tile encoding (data/crec.TileOnlineFeed): fold+tile-group
+    # streaming blocks (crec v1 / dense-text) on the pipeline workers and
+    # run the MXU tile step instead of gather/scatter or dense-apply.
+    # "auto" engages on the TPU backend when the store has a tile step,
+    # the run is single-process and the tilemm limits admit the geometry;
+    # "on" forces it (errors when inadmissible — the parity-test mode);
+    # "off" keeps the existing scatter/dense paths. crec2 files are
+    # already tile-grouped and ignore this knob.
+    tile_online: str = "auto"
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1   # save a checkpoint every N data passes

@@ -989,20 +989,26 @@ _CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
 _DEFAULT_BUDGET = 840.0  # under the 15-min harness timeout, with margin
 
 
-def _phase_telemetry() -> dict:
+def _phase_telemetry(wall_s=None) -> dict:
     """Per-phase telemetry record from the trace ring (span totals,
-    stall fractions) plus any straggler flags visible in the heartbeat
-    directory. Caller resets the ring between phases."""
-    from wormhole_tpu.obs import (trace, read_heartbeats,
+    stall fractions, the step ledger) plus any straggler flags visible
+    in the heartbeat directory. Caller resets the ring between phases
+    and passes the measured phase wall time so the ledger buckets have
+    a sum target (``wall_s=None`` falls back to the span extent)."""
+    from wormhole_tpu.obs import (trace, ledger, read_heartbeats,
                                   StragglerDetector)
     spans = trace.summary()
     stall_s = sum(v["total_s"] for k, v in spans.items()
                   if k.endswith("_stall"))
     busy_s = sum(v["total_s"] for k, v in spans.items()
                  if not k.endswith("_stall"))
+    led = ledger.build(trace.events(), wall_s=wall_s)
+    ledger.to_registry(led)
     rec = {"spans": spans,
            "stall_sec": round(stall_s, 3),
-           "stall_frac": round(stall_s / max(stall_s + busy_s, 1e-9), 4)}
+           "stall_frac": round(stall_s / max(stall_s + busy_s, 1e-9), 4),
+           "ledger": led,
+           "dropped_spans": trace.dropped()}
     hb_dir = os.environ.get("WORMHOLE_METRICS_EXPORT", "")
     if hb_dir:
         rec["straggler_flags"] = StragglerDetector().check(
@@ -1251,9 +1257,9 @@ def main(argv=None) -> None:
                   file=sys.stderr, flush=True)
         if args.telemetry:
             from wormhole_tpu.obs import trace
-            telemetry[name] = _phase_telemetry()
-            telemetry[name]["phase_sec"] = round(
-                time.perf_counter() - t0, 3)
+            phase_sec = time.perf_counter() - t0
+            telemetry[name] = _phase_telemetry(wall_s=phase_sec)
+            telemetry[name]["phase_sec"] = round(phase_sec, 3)
             if args.trace_path:
                 trace_events.extend(trace.events())
             trace.reset()        # each phase gets the whole ring

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Regression gate over the ``BENCH_r*.json`` trajectory.
+
+Each roadmap run snapshots ``bench.py`` results into ``BENCH_rNN.json``
+(wrapper: ``{cmd, n, parsed, rc, tail}`` where ``parsed`` is the
+headline ``{metric, value, unit, vs_baseline, extra}``). This gate walks
+the trajectory in run order and fails (exit 1) when the newest run
+regresses against its predecessor:
+
+- **Throughput**: every numeric ``*ex_per_sec`` / ``*examples_per_sec``
+  / ``*rows_per_sec`` key reachable through ``parsed`` (recursively
+  through nested dicts, by dotted path) must not drop below
+  ``prev * (1 - tol)``. Default ``--tol 0.25``: the real trajectory's
+  worst benign run-to-run ratio is 0.834 (criteo_text r02→r03 and
+  e2e_cold_stream r03→r04 — CPU-host noise), so 25% passes history
+  while catching a halving.
+- **Headline**: ``parsed.value`` is compared only when the two runs'
+  ``metric`` names match (r01 reports ``ftrl_async_sgd_examples_per_sec``,
+  later runs ``end_to_end_examples_per_sec`` — not comparable).
+- **Ledger fractions**: when both runs carry a ledger block (bench.py
+  ``--out`` telemetry, ``{"ledger": {"frac": {...}}}`` anywhere under
+  ``parsed``), the ``unattributed`` and ``residual_stall`` fractions may
+  not grow by more than ``--tol-frac`` (absolute, default 0.10) at the
+  same path — growth there means wall time leaked out of the accounted
+  buckets.
+
+Runs that did not produce a result (``parsed`` null or ``rc != 0`` —
+e.g. r05's rc=124 timeout) are skipped with a note: a crashed run is the
+roadmap's problem, not a throughput regression, and must not poison the
+comparison chain.
+
+Usage::
+
+    python scripts/bench_check.py                 # gate ./BENCH_r*.json
+    python scripts/bench_check.py --dir runs/ --tol 0.2
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
+_LEDGER_FRACS = ("unattributed", "residual_stall")
+
+
+def load_runs(bench_dir: str) -> List[Tuple[str, Optional[dict]]]:
+    """[(run_name, parsed-or-None)] in run order; None = skipped run."""
+    out: List[Tuple[str, Optional[dict]]] = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        name = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_check: {name}: unreadable ({e}); skipped")
+            out.append((name, None))
+            continue
+        parsed = doc.get("parsed")
+        rc = doc.get("rc", 0)
+        if not isinstance(parsed, dict) or rc != 0:
+            print(f"bench_check: {name}: no result (rc={rc}); skipped")
+            out.append((name, None))
+            continue
+        out.append((name, parsed))
+    return out
+
+
+def rate_keys(parsed: dict) -> Dict[str, float]:
+    """dotted-path -> value for every numeric throughput key under
+    ``parsed``. Paths (not bare leaf names) keep r02's ``e2e.ex_per_sec``
+    distinct from r03's ``e2e_steady_cached.ex_per_sec`` — different
+    benchmarks, never compared."""
+    rates: Dict[str, float] = {}
+
+    def walk(node, path: str) -> None:
+        if not isinstance(node, dict):
+            return
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else k
+            if isinstance(v, dict):
+                walk(v, p)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and _RATE_PAT.search(k):
+                rates[p] = float(v)
+    walk(parsed, "")
+    return rates
+
+
+def ledger_fracs(parsed: dict) -> Dict[str, float]:
+    """dotted-path -> fraction for the gated ledger fractions found in
+    any ``{"ledger": {"frac": {...}}}`` block under ``parsed``."""
+    fracs: Dict[str, float] = {}
+
+    def walk(node, path: str) -> None:
+        if not isinstance(node, dict):
+            return
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else k
+            if k == "ledger" and isinstance(v, dict) \
+                    and isinstance(v.get("frac"), dict):
+                for name in _LEDGER_FRACS:
+                    fv = v["frac"].get(name)
+                    if isinstance(fv, (int, float)):
+                        fracs[f"{p}.frac.{name}"] = float(fv)
+            elif isinstance(v, dict):
+                walk(v, p)
+    walk(parsed, "")
+    return fracs
+
+
+def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
+            tol: float, tol_frac: float) -> List[str]:
+    """Regression messages for one consecutive pair (empty = clean)."""
+    bad: List[str] = []
+    if prev.get("metric") == cur.get("metric") \
+            and isinstance(prev.get("value"), (int, float)) \
+            and isinstance(cur.get("value"), (int, float)):
+        pv, cv = float(prev["value"]), float(cur["value"])
+        if pv > 0 and cv < pv * (1.0 - tol):
+            bad.append(
+                f"headline {cur['metric']}: {cv:.1f} < "
+                f"{pv:.1f} * {1 - tol:.2f} ({cur_name} vs {prev_name})")
+    prates, crates = rate_keys(prev), rate_keys(cur)
+    for key in sorted(set(prates) & set(crates)):
+        pv, cv = prates[key], crates[key]
+        if key == "value" or pv <= 0:
+            continue   # headline handled above (metric-name guarded)
+        if cv < pv * (1.0 - tol):
+            bad.append(
+                f"{key}: {cv:.1f} < {pv:.1f} * {1 - tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name})")
+    pfracs, cfracs = ledger_fracs(prev), ledger_fracs(cur)
+    for key in sorted(set(pfracs) & set(cfracs)):
+        if cfracs[key] > pfracs[key] + tol_frac:
+            bad.append(
+                f"{key}: {cfracs[key]:.3f} > {pfracs[key]:.3f} + "
+                f"{tol_frac:.2f} ({cur_name} vs {prev_name}) — wall "
+                "time leaking out of accounted buckets")
+    return bad
+
+
+def run(bench_dir: str, tol: float, tol_frac: float,
+        all_pairs: bool = False) -> int:
+    runs = [(n, p) for n, p in load_runs(bench_dir) if p is not None]
+    if len(runs) < 2:
+        print(f"bench_check: {len(runs)} usable run(s) under "
+              f"{bench_dir!r}; nothing to gate")
+        return 0
+    pairs = list(zip(runs, runs[1:])) if all_pairs else [runs[-2:]]
+    failures: List[str] = []
+    compared = 0
+    for (pn, pp), (cn, cp) in pairs:
+        compared += len(set(rate_keys(pp)) & set(rate_keys(cp)))
+        failures.extend(compare(pn, pp, cn, cp, tol, tol_frac))
+    if failures:
+        print(f"bench_check: {len(failures)} regression(s):",
+              file=sys.stderr)
+        for msg in failures:
+            print(f"  {msg}", file=sys.stderr)
+        return 1
+    print(f"bench_check: OK ({len(pairs)} pair(s), {compared} shared "
+          f"throughput keys, tol {tol:.0%}, ledger tol "
+          f"+{tol_frac:.2f})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: cwd)")
+    ap.add_argument("--tol", type=float, default=0.25,
+                    help="relative throughput drop tolerated vs the "
+                         "previous run (default 0.25; history's worst "
+                         "benign ratio is 0.834)")
+    ap.add_argument("--tol-frac", type=float, default=0.10,
+                    help="absolute growth tolerated in the ledger "
+                         "unattributed/residual_stall fractions "
+                         "(default 0.10)")
+    ap.add_argument("--all-pairs", action="store_true",
+                    help="gate every consecutive pair in the "
+                         "trajectory, not just the newest one")
+    args = ap.parse_args(argv)
+    return run(args.dir, args.tol, args.tol_frac, all_pairs=args.all_pairs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

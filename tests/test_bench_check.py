@@ -1,0 +1,150 @@
+"""The bench regression gate (scripts/bench_check.py): green on the
+repo's real BENCH_r*.json trajectory, red on an injected throughput
+drop or a ledger fraction creeping up, and unparseable runs (crashed /
+timed-out benches) are skipped rather than poisoning the chain."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_check.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True)
+
+
+def _write_run(d, n, parsed, rc=0):
+    doc = {"n": n, "cmd": "bench", "rc": rc, "tail": [], "parsed": parsed}
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def _parsed(value, extra=None, metric="end_to_end_examples_per_sec"):
+    p = {"metric": metric, "value": value, "unit": "examples/sec",
+         "vs_baseline": 1.0}
+    if extra:
+        p["extra"] = extra
+    return p
+
+
+def test_real_trajectory_passes():
+    r = _run("--dir", REPO)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "OK" in r.stdout
+    # the timed-out r05 is skipped, not compared
+    assert "BENCH_r05" in r.stdout and "skipped" in r.stdout
+    r2 = _run("--dir", REPO, "--all-pairs")
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+
+
+def test_injected_throughput_regression_fails(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"criteo_text_examples_per_sec": 50_000.0}))
+    _write_run(d, 2, _parsed(48_000.0,      # 52% drop: way past tol
+                             {"criteo_text_examples_per_sec": 49_000.0}))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "end_to_end_examples_per_sec" in r.stderr
+    # the healthy satellite metric is not reported
+    assert "criteo_text" not in r.stderr
+
+
+def test_nested_extra_rate_regression_fails(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"e2e": {"ex_per_sec": 100_000.0}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"e2e": {"ex_per_sec": 40_000.0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 1
+    assert "e2e.ex_per_sec" in r.stderr
+
+
+def test_within_tolerance_passes(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0))
+    _write_run(d, 2, _parsed(80_000.0))     # -20% < default 25% tol
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stderr
+    # tightening the tolerance flips the verdict
+    assert _run("--dir", d, "--tol", "0.1").returncode == 1
+
+
+def test_metric_rename_not_compared(tmp_path):
+    # r01's headline metric differs from later runs' — never compared
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(600_000_000.0,
+                             metric="ftrl_async_sgd_examples_per_sec"))
+    _write_run(d, 2, _parsed(76_000.0))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stderr
+
+
+def test_crashed_run_skipped_and_chain_bridges(tmp_path):
+    # r2 timed out (rc=124, parsed null): the gate compares r3 vs r1
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0))
+    _write_run(d, 2, None, rc=124)
+    _write_run(d, 3, _parsed(95_000.0))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stderr
+    assert "BENCH_r02" in r.stdout and "skipped" in r.stdout
+    # and a real drop across the bridge still fails
+    _write_run(d, 3, _parsed(40_000.0))
+    assert _run("--dir", d).returncode == 1
+
+
+def test_ledger_fraction_creep_fails(tmp_path):
+    d = str(tmp_path)
+    led = lambda unattr: {"telemetry": {"e2e": {"ledger": {
+        "frac": {"unattributed": unattr, "residual_stall": 0.02}}}}}
+    _write_run(d, 1, _parsed(100_000.0, led(0.05)))
+    _write_run(d, 2, _parsed(100_000.0, led(0.30)))   # +0.25 > 0.10
+    r = _run("--dir", d)
+    assert r.returncode == 1
+    assert "unattributed" in r.stderr
+    # inside tolerance: fine
+    _write_run(d, 2, _parsed(100_000.0, led(0.12)))
+    assert _run("--dir", d).returncode == 0
+
+
+def test_fewer_than_two_runs_is_vacuous(tmp_path):
+    assert _run("--dir", str(tmp_path)).returncode == 0
+    _write_run(str(tmp_path), 1, _parsed(1.0))
+    r = _run("--dir", str(tmp_path))
+    assert r.returncode == 0
+    assert "nothing to gate" in r.stdout
+
+
+def test_real_trajectory_with_injected_drop_fails(tmp_path):
+    """ISSUE acceptance: copy the real trajectory, halve the newest
+    run's headline -> nonzero exit."""
+    d = str(tmp_path)
+    names = sorted(n for n in os.listdir(REPO)
+                   if n.startswith("BENCH_r") and n.endswith(".json"))
+    for n in names:
+        shutil.copy(os.path.join(REPO, n), os.path.join(d, n))
+    # newest usable run is r04: halve every throughput figure
+    p = os.path.join(d, "BENCH_r04.json")
+    doc = json.load(open(p))
+
+    def halve(node):
+        for k, v in list(node.items()):
+            if isinstance(v, dict):
+                halve(v)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and k.endswith(("ex_per_sec", "examples_per_sec",
+                                    "rows_per_sec")):
+                node[k] = v / 2
+    halve(doc["parsed"])
+    doc["parsed"]["value"] /= 2
+    json.dump(doc, open(p, "w"))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "regression" in r.stderr

@@ -562,3 +562,130 @@ def test_mp_straggler_reexecution_sparse(tmp_path):
     assert len({ln.split("rank ")[1][2:] for ln in rows}) == 1, out
     num_ex = int(rows[0].split("num_ex=")[1].split()[0])
     assert num_ex == 2700, out
+
+
+def test_mp_trace_merge_and_skew_report(tmp_path):
+    """--trace-dir end to end (PR-6): both ranks trace into the exported
+    directory via the obs.setup env fallback, rank 1 arrives late at
+    every sited collective, and the launcher's exit-time merge produces
+    one merged Perfetto trace plus a skew report naming rank 1 with its
+    per-collective lateness."""
+    import json
+    trace_dir = tmp_path / "traces"
+    hb_dir = tmp_path / "hb"
+    r = run_mp(2, """
+        import time
+        import numpy as np
+        from wormhole_tpu.parallel.mesh import MeshRuntime
+        from wormhole_tpu import obs
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.utils.config import Config
+        rt = MeshRuntime.create()
+        hub = obs.setup(Config(), rank=rt.rank)
+        # both launcher env fallbacks picked up: heartbeat + trace dirs
+        assert hub.active and hub.export_dir, "env fallbacks missing"
+        from wormhole_tpu.obs import trace as _t
+        assert _t.enabled(), "trace env fallback missing"
+        hub.heartbeat_tick(step=0, num_ex=0)
+        for i in range(4):
+            if rt.rank == 1:
+                time.sleep(0.1)        # the planted straggler
+            total = allreduce_tree(np.asarray(float(rt.rank + 1)),
+                                   rt.mesh, "sum", site="test/step")
+            assert float(total) == 3.0, total
+        hub.finalize(step=4, num_ex=400, wall_s=1.0)
+        print(f"OK rank {rt.rank}")
+    """, launcher_args=("--heartbeat-dir", str(hb_dir),
+                        "--trace-dir", str(trace_dir)), raw=True)
+    if (r.returncode != 0 and "Multiprocess computations aren't"
+            in r.stdout + r.stderr):
+        pytest.skip("jax CPU backend lacks multiprocess collectives "
+                    "in this environment")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("OK rank") == 2
+
+    # per-rank trace files + the merged artifacts exist
+    assert (trace_dir / "trace.json").exists()
+    assert (trace_dir / "trace.r1.json").exists()
+    assert (trace_dir / "merged.trace.json").exists()
+    assert (trace_dir / "skew_report.json").exists()
+
+    report = json.load(open(trace_dir / "skew_report.json"))
+    assert report["ranks"] == [0, 1]
+    assert report["clock_source"] == "heartbeat"
+    assert report["collectives_matched"] >= 3
+    # the delayed rank is named, last in (nearly) every collective,
+    # ~100 ms late each time
+    w = report["worst"]
+    assert w["rank"] == 1, report
+    assert w["last_in"] >= report["collectives_matched"] - 1, report
+    assert w["lateness_ms"] > 50 * w["last_in"], report
+    assert report["sites"]["test/step"]["max_skew_ms"] > 50, report
+
+    # the merged doc carries both ranks' events on one timeline
+    merged = json.load(open(trace_dir / "merged.trace.json"))
+    assert merged["metadata"]["merged"] is True
+    pids = {e.get("pid") for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert {0, 1} <= pids, pids
+
+    # and the launcher printed the attribution lines
+    assert "merged trace:" in r.stderr, r.stderr
+    assert "collective skew: w1" in r.stderr, r.stderr
+
+
+def test_mp_trace_merge_without_jax_distributed(tmp_path):
+    """The exit-time merge, backend-independent: workers skip
+    jax.distributed (no CPU multiprocess collectives needed) and record
+    sited collective spans on the single-process fast path — the span
+    boundary and (site, seq) stamping are identical. Rank 1 sleeps
+    before every collective, so the launcher-side merge must name it
+    with growing per-collective lateness."""
+    import json
+    trace_dir = tmp_path / "traces"
+    hb_dir = tmp_path / "hb"
+    r = run_mp(2, """
+        import os, time
+        import numpy as np
+        from wormhole_tpu import obs
+        from wormhole_tpu.obs import trace
+        from wormhole_tpu.obs.metrics import Registry
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        from wormhole_tpu.utils.config import Config
+        rank = int(os.environ["PROCESS_ID"])
+        hub = obs.setup(Config(), rank=rank, registry=Registry())
+        assert hub.active and trace.enabled(), "env fallbacks missing"
+        hub.heartbeat_tick(step=0, num_ex=0)
+        for i in range(4):
+            if rank == 1:
+                time.sleep(0.1)            # the planted straggler
+            allreduce_tree(np.asarray(1.0), None, "sum",
+                           site="test/step")
+        hub.finalize(step=4, num_ex=400, wall_s=1.0)
+        print(f"OK rank {rank}")
+    """, launcher_args=("--heartbeat-dir", str(hb_dir),
+                        "--trace-dir", str(trace_dir)), raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("OK rank") == 2
+
+    assert (trace_dir / "trace.json").exists()
+    assert (trace_dir / "trace.r1.json").exists()
+    assert (trace_dir / "merged.trace.json").exists()
+    report = json.load(open(trace_dir / "skew_report.json"))
+    assert report["ranks"] == [0, 1]
+    assert report["clock_source"] == "heartbeat"
+    assert report["collectives_matched"] == 4
+    w = report["worst"]
+    assert w["rank"] == 1, report
+    # cumulative sleeps: rank 1 trails by ~100*k ms at the k-th
+    # collective; spawn skew between the two children is far smaller
+    assert w["lateness_ms"] > 300, report
+    # JSON object keys are strings on disk
+    assert report["per_rank"]["1"]["last_in"] >= 3, report
+    assert report["sites"]["test/step"]["max_skew_ms"] > 100, report
+    merged = json.load(open(trace_dir / "merged.trace.json"))
+    pids = {e.get("pid") for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert {0, 1} <= pids, pids
+    assert "merged trace:" in r.stderr, r.stderr
+    assert "collective skew: w1" in r.stderr, r.stderr

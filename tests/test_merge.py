@@ -1,0 +1,218 @@
+"""Cross-rank trace aggregation (wormhole_tpu/obs/merge.py) and the
+collective (site, seq) stamping it matches on
+(parallel/collectives.py).
+
+Fabricated per-rank trace docs + heartbeat files stand in for a real
+multi-process run (the launcher integration lives in
+test_launcher_mp.py): the merge must align rank timelines on the
+heartbeat-derived clock offsets, match collective spans by (site, seq),
+and name the straggling rank with its per-collective lateness."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.obs import trace
+from wormhole_tpu.obs import merge
+from wormhole_tpu.obs.heartbeat import heartbeat_path
+
+
+@pytest.fixture(autouse=True)
+def _trace_off():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+# -- fabricated multi-rank runs ----------------------------------------------
+
+def _coll_ev(site, seq, ts_us, dur_us, tid=1):
+    return {"ph": "X", "name": "collective:allreduce_sum",
+            "cat": "collective", "pid": 0, "tid": tid,
+            "ts": float(ts_us), "dur": float(dur_us),
+            "args": {"site": site, "seq": seq}}
+
+
+def _rank_doc(rank, mono_t0, events, dropped=0):
+    return {"traceEvents": list(events), "displayTimeUnit": "ms",
+            "metadata": {"rank": rank, "mono_t0": mono_t0,
+                         "wall_t0": 1000.0 + mono_t0,
+                         "dropped_spans": dropped}}
+
+
+def _hb(rank, mono_t0, wall_offset, n=3):
+    """Heartbeat records whose ts/mono pairs encode mono_t0 + a wall
+    offset for this rank (merge derives offset = median(ts - mono))."""
+    return [{"ts": 1000.0 + wall_offset + mono_t0 + i,
+             "mono": mono_t0 + float(i), "rank": rank, "seq": i,
+             "ex_per_sec": 100.0}
+            for i in range(n)]
+
+
+def test_clock_offsets_median_robust():
+    hb = {0: _hb(0, 50.0, 0.0)}
+    # one torn/laggy sample must not move the offset (median, not mean)
+    hb[0].append({"ts": 99999.0, "mono": 50.0, "rank": 0, "seq": 9})
+    offs = merge.clock_offsets(hb)
+    assert offs[0] == pytest.approx(1000.0)
+    assert merge.clock_offsets({1: [{"rank": 1}]}) == {}   # no stamps
+
+
+def test_merge_matches_collectives_and_names_straggler():
+    # rank 1 arrives 5 ms late at every collective. Its recorder started
+    # 7 s after rank 0's on the shared monotonic clock (mono_t0 107 vs
+    # 100), so the same instants sit 7 s apart in the two files'
+    # relative timestamps — the alignment must undo exactly that
+    ev0 = [_coll_ev("s/a", 0, 7_010_000, 6_000),
+           _coll_ev("s/a", 1, 7_030_000, 6_000),
+           _coll_ev("s/b", 0, 7_050_000, 2_000)]
+    ev1 = [_coll_ev("s/a", 0, 15_000, 1_000),
+           _coll_ev("s/a", 1, 35_000, 1_000),
+           _coll_ev("s/b", 0, 55_000, 1_000)]
+    docs = {0: _rank_doc(0, 100.0, ev0), 1: _rank_doc(1, 107.0, ev1, 3)}
+    hb = {0: _hb(0, 100.0, 0.0), 1: _hb(1, 107.0, 0.0)}
+    merged, report = merge.merge_traces(docs, hb)
+
+    assert report["clock_source"] == "heartbeat"
+    assert report["collectives_matched"] == 3
+    assert report["ranks"] == [0, 1]
+    # both ranks' wall clocks agree -> zero offset difference
+    assert report["clock_offset_s"] == {0: 0.0, 1: 0.0}
+    assert report["dropped_spans"] == {0: 0, 1: 3}
+    # rank 1 was last every time, 5 ms late each
+    pr = report["per_rank"][1]
+    assert pr["last_in"] == 3
+    assert pr["total_lateness_ms"] == pytest.approx(15.0)
+    assert pr["max_lateness_ms"] == pytest.approx(5.0)
+    assert report["per_rank"][0]["last_in"] == 0
+    w = report["worst"]
+    assert w["rank"] == 1 and w["last_in"] == 3 and w["of"] == 3
+    assert w["lateness_ms"] == pytest.approx(15.0)
+    assert report["sites"]["s/a"]["n"] == 2
+    assert report["sites"]["s/a"]["max_skew_ms"] == pytest.approx(5.0)
+    assert report["sites"]["s/a"]["last_counts"] == {1: 2}
+
+    # the merged doc: every event present, timeline rebased near zero,
+    # and the two ranks' same-(site,seq) spans 5 ms apart
+    evs = merged["traceEvents"]
+    assert len(evs) == 6
+    assert merged["metadata"]["merged"] is True
+    by_rank_ts = {}
+    for e in evs:
+        key = (e["args"]["site"], e["args"]["seq"])
+        by_rank_ts.setdefault(key, []).append(e["ts"])
+    for key, stamps in by_rank_ts.items():
+        assert max(stamps) - min(stamps) == pytest.approx(5_000.0)
+    assert min(e["ts"] for e in evs) == pytest.approx(0.0)
+
+
+def test_merge_reports_wall_clock_disagreement():
+    # same monotonic arrivals, but rank 1's wall clock runs 2 s ahead:
+    # skew math (heartbeat clock) is unaffected, and the disagreement
+    # is surfaced instead of folded in silently
+    ev = [_coll_ev("s/a", 0, 10_000, 1_000)]
+    docs = {0: _rank_doc(0, 100.0, ev), 1: _rank_doc(1, 100.0, ev)}
+    hb = {0: _hb(0, 100.0, 0.0), 1: _hb(1, 100.0, 2.0)}
+    _merged, report = merge.merge_traces(docs, hb)
+    assert report["clock_offset_s"][1] == pytest.approx(2.0)
+    assert report["sites"]["s/a"]["max_skew_ms"] == pytest.approx(0.0)
+
+
+def test_merge_without_heartbeats_uses_wall_t0():
+    ev = [_coll_ev("s/a", 0, 10_000, 1_000)]
+    docs = {0: _rank_doc(0, 100.0, ev), 1: _rank_doc(1, 103.0, ev)}
+    _merged, report = merge.merge_traces(docs, {})
+    assert report["clock_source"] == "trace_wall_t0"
+    # wall_t0 anchors differ by 3 s -> the same relative ts land 3 s
+    # apart on the unified timeline
+    assert report["sites"]["s/a"]["max_skew_ms"] == pytest.approx(3_000.0)
+
+
+def test_merge_run_writes_artifacts_and_is_idempotent(tmp_path):
+    d = str(tmp_path)
+    for rank, delay in ((0, 0), (1, 5_000)):
+        doc = _rank_doc(rank, 100.0,
+                        [_coll_ev("s/a", 0, 10_000 + delay, 1_000)])
+        name = "trace.json" if rank == 0 else f"trace.r{rank}.json"
+        with open(os.path.join(d, name), "w") as f:
+            json.dump(doc, f)
+    hb_dir = str(tmp_path / "hb")
+    os.makedirs(hb_dir)
+    for rank in (0, 1):
+        with open(heartbeat_path(hb_dir, rank), "w") as f:
+            for rec in _hb(rank, 100.0, 0.0):
+                f.write(json.dumps(rec) + "\n")
+
+    res = merge.merge_run(d, hb_dir)
+    assert res is not None
+    merged_path, report = res
+    assert os.path.basename(merged_path) == merge.MERGED_TRACE
+    assert report["worst"]["rank"] == 1
+    assert report["worst"]["lateness_ms"] == pytest.approx(5.0)
+    on_disk = json.load(open(os.path.join(d, merge.SKEW_REPORT)))
+    assert on_disk["worst"]["rank"] == 1
+    json.load(open(merged_path))                     # valid JSON doc
+
+    # re-running must skip the merged output file (metadata.merged) and
+    # reproduce the same report, not merge the merge
+    res2 = merge.merge_run(d, hb_dir)
+    assert res2 is not None
+    assert res2[1]["ranks"] == [0, 1]
+    assert res2[1]["collectives_matched"] == 1
+
+
+def test_merge_run_empty_dir_returns_none(tmp_path):
+    assert merge.merge_run(str(tmp_path)) is None
+    assert merge.merge_run(str(tmp_path / "missing")) is None
+
+
+# -- collective (site, seq) stamping -----------------------------------------
+
+def test_collective_spans_carry_site_seq():
+    from wormhole_tpu.parallel import collectives as C
+    C.reset_site_seq()
+    trace.enable()
+    for _ in range(2):
+        C.allreduce_tree(np.ones(4), None, "sum", site="grad/step")
+    C.allreduce_tree(np.ones(4), None, "max", site="metrics")
+    spans = [e for e in trace.events()
+             if e["ph"] == "X" and e.get("cat") == "collective"]
+    stamped = [(e["args"]["site"], e["args"]["seq"]) for e in spans
+               if e.get("args")]
+    # per-site sequence numbers: the Nth call at a site is the same
+    # logical collective on every rank — merge.py's matching key
+    assert ("grad/step", 0) in stamped
+    assert ("grad/step", 1) in stamped
+    assert ("metrics", 0) in stamped
+    C.reset_site_seq()
+    C.allreduce_tree(np.ones(4), None, "sum", site="grad/step")
+    last = [e for e in trace.events()
+            if e.get("args") and e["args"].get("site") == "grad/step"]
+    assert last[-1]["args"]["seq"] == 0           # reset for a new run
+
+
+def test_seq_advances_with_tracing_off():
+    # the counter must advance even while tracing is off, or a rank
+    # that enables tracing late would desync its seq from its peers
+    from wormhole_tpu.parallel import collectives as C
+    C.reset_site_seq()
+    assert not trace.enabled()
+    C.allreduce_tree(np.ones(2), None, "sum", site="s")
+    C.allreduce_tree(np.ones(2), None, "sum", site="s")
+    trace.enable()
+    C.allreduce_tree(np.ones(2), None, "sum", site="s")
+    spans = [e for e in trace.events() if e.get("args")]
+    assert spans[-1]["args"]["seq"] == 2
+    C.reset_site_seq()
+
+
+def test_unsited_collectives_unstamped():
+    from wormhole_tpu.parallel import collectives as C
+    trace.enable()
+    C.allreduce_tree(np.ones(2), None, "sum")
+    spans = [e for e in trace.events() if e["ph"] == "X"]
+    assert spans and all("args" not in e or not e.get("args")
+                         for e in spans)

@@ -333,6 +333,12 @@ class DeviceFeed:
                 return False
             with self._lock:
                 self._ring_max = max(self._ring_max, ring.qsize())
+            if trace.enabled():
+                # counter track: ring depth over time renders as a line
+                # chart next to the stage spans (empty ring under a
+                # consume_stall = starved feed, full = device-bound)
+                trace.counter(f"{self.name}:ring", ring.qsize(),
+                              cat="feed")
             return True
 
         def transferrer() -> None:

@@ -929,6 +929,7 @@ class AsyncSGD:
         """Pass/workload loop (AsyncSGDScheduler::Run, async_sgd.h:294-348)."""
         if jax.process_count() > 1:
             return self.run_multihost()
+        run_t0 = time.monotonic()   # obs ledger: measured run wall time
         cfg = self.cfg
         worker = f"proc{self.rt.rank}"
         print(Progress.HEADER)
@@ -1005,7 +1006,8 @@ class AsyncSGD:
                               num_ex=self.progress.num_ex,
                               feed_stall=self.feed_stats["feed_stall"],
                               timer=self.timer, progress=self.progress,
-                              feed_stats=None)
+                              feed_stats=None,
+                              wall_s=time.monotonic() - run_t0)
         return self.progress
 
     # -- multi-host synchronized training -----------------------------------
@@ -1445,6 +1447,7 @@ class AsyncSGD:
         from wormhole_tpu.parallel.checkpoint import ShardCheckpointer
         from wormhole_tpu.parallel.collectives import allreduce_tree
         from wormhole_tpu.ops.metrics import auc_np
+        run_t0 = time.monotonic()   # obs ledger: measured run wall time
         cfg = self.cfg
         crec = cfg.data_format in ("crec", "crec2")
         if crec:
@@ -1533,7 +1536,8 @@ class AsyncSGD:
                               num_ex=self.progress.num_ex,
                               feed_stall=self.feed_stats["feed_stall"],
                               timer=self.timer, progress=self.progress,
-                              feed_stats=None)
+                              feed_stats=None,
+                              wall_s=time.monotonic() - run_t0)
         return self.progress
 
     def _allreduce_pooled_auc(self, pooled: list) -> float:

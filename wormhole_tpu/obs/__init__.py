@@ -38,11 +38,14 @@ from .heartbeat import (HeartbeatWriter, HeartbeatMonitor,
 __all__ = ["trace", "metrics", "heartbeat", "Obs", "setup",
            "Registry", "default_registry", "merge_snapshots",
            "HeartbeatWriter", "HeartbeatMonitor", "StragglerDetector",
-           "read_heartbeats"]
+           "read_heartbeats", "METRICS_EXPORT_ENV", "TRACE_EXPORT_ENV"]
 
 # launch_mp exports this so workers inherit the launcher's heartbeat
 # directory without every config file naming one
 METRICS_EXPORT_ENV = "WORMHOLE_METRICS_EXPORT"
+# launch_mp --trace-dir exports this: workers trace into the directory
+# (per-rank files via _rank_path) and the launcher merges them at exit
+TRACE_EXPORT_ENV = "WORMHOLE_TRACE_EXPORT"
 
 
 def _rank_path(path: str, rank: int) -> str:
@@ -68,7 +71,7 @@ class Obs:
             else default_registry()
         self.hb: Optional[HeartbeatWriter] = None
         if self.trace_path:
-            trace.enable(self.trace_path)
+            trace.enable(self.trace_path, pid=rank)
         if self.export_dir:
             try:
                 self.hb = HeartbeatWriter(self.export_dir, rank,
@@ -99,13 +102,28 @@ class Obs:
 
     def finalize(self, step: int = 0, num_ex: int = 0,
                  feed_stall: float = 0.0, timer=None, progress=None,
-                 feed_stats=None, mesh=None) -> None:
-        """Run-end flush: ingest the legacy surfaces, optionally merge
-        across hosts, write the trace JSON, the Prometheus dump, and a
-        final heartbeat. Never raises into the caller."""
+                 feed_stats=None, mesh=None, wall_s: float = 0.0) -> None:
+        """Run-end flush: ingest the legacy surfaces, build the step
+        ledger (when tracing is on and the caller measured ``wall_s``),
+        optionally merge across hosts, write the trace JSON, the
+        Prometheus dump, and a final heartbeat. Never raises into the
+        caller."""
         try:
             self.ingest(timer=timer, progress=progress,
                         feed_stats=feed_stats)
+            if self.trace_path:
+                # run-level wall-time attribution (obs/ledger.py): built
+                # on the caller's thread — the run loop's — so the
+                # main-timeline spans are the ones attributed
+                from . import ledger as _ledger
+                led = _ledger.build(trace.events(),
+                                    wall_s=wall_s if wall_s > 0 else None)
+                _ledger.to_registry(led, self.registry)
+                self.registry.counter(
+                    "trace/dropped_spans",
+                    help="events evicted from the bounded trace ring "
+                         "(nonzero = truncated trace)"
+                ).value = float(trace.dropped())
             if mesh is not None and self.registry.names():
                 self.registry.allreduce(mesh)
             if self.trace_path:
@@ -134,11 +152,19 @@ def setup(cfg, rank: int = 0,
           registry: Optional[Registry] = None) -> Obs:
     """Build a hub from ``Config`` knobs. ``metrics_export`` falls back
     to the launcher's exported directory (``WORMHOLE_METRICS_EXPORT``)
-    so ``launch_mp --heartbeat-dir`` works without a config change."""
+    so ``launch_mp --heartbeat-dir`` works without a config change;
+    ``trace_path`` likewise falls back to ``WORMHOLE_TRACE_EXPORT``
+    (``launch_mp --trace-dir``), which traces every rank into that
+    directory for the exit-time merge (obs/merge.py)."""
     export = getattr(cfg, "metrics_export", "") \
         or os.environ.get(METRICS_EXPORT_ENV, "")
+    trace_path = getattr(cfg, "trace_path", "")
+    if not trace_path:
+        trace_dir = os.environ.get(TRACE_EXPORT_ENV, "")
+        if trace_dir:
+            trace_path = os.path.join(trace_dir, "trace.json")
     return Obs(rank=rank,
-               trace_path=getattr(cfg, "trace_path", ""),
+               trace_path=trace_path,
                metrics_export=export,
                heartbeat_itv=getattr(cfg, "heartbeat_itv", 5.0),
                registry=registry)

@@ -66,7 +66,11 @@ class HeartbeatWriter:
         ex_s = (num_ex - self._prev_ex) / dt if dt > 0 else 0.0
         stall_rate = ((feed_stall - self._prev_stall) / dt
                       if dt > 0 else 0.0)
-        rec = {"ts": round(time.time(), 3), "rank": self.rank,
+        # ts (wall) and mono (monotonic) sampled together: obs/merge.py
+        # derives each rank's wall<->monotonic clock offset from their
+        # difference to align per-rank trace files
+        rec = {"ts": round(time.time(), 3), "mono": round(now, 4),
+               "rank": self.rank,
                "seq": self._seq, "step": int(step),
                "num_ex": int(num_ex), "ex_per_sec": round(ex_s, 2),
                "feed_stall_rate": round(stall_rate, 4)}
@@ -150,9 +154,15 @@ class StragglerDetector:
 
 class HeartbeatMonitor:
     """Launcher-side aggregator: a daemon thread that scans a heartbeat
-    directory every ``interval`` seconds and logs straggler warnings
-    (rate-limited per rank, so a persistently slow worker warns once a
-    minute instead of every scan)."""
+    directory every ``interval`` seconds and logs straggler warnings.
+
+    Warnings are deduplicated per (rank, incident): a rank that crosses
+    the floor opens an incident and warns ONCE; while the incident is
+    open it stays silent (``rewarn_after`` is the escape hatch — a
+    "still straggling" reminder for very long incidents); when the rank
+    climbs back above the floor (or finishes) the incident closes with a
+    recovery line, and a later relapse opens incident #2 with a fresh
+    warning."""
 
     def __init__(self, directory: str, factor: float = 3.0,
                  interval: float = 5.0, sink=None,
@@ -162,23 +172,48 @@ class HeartbeatMonitor:
         self.interval = interval
         self.rewarn_after = rewarn_after
         self._sink = sink
-        self._warned: Dict[int, float] = {}
+        # rank -> open incident {"n": ordinal, "t0": mono, "warned": mono}
+        self._incidents: Dict[int, dict] = {}
+        self._incident_count: Dict[int, int] = {}
         self._stop = None
         self._thread = None
 
     def scan_once(self) -> List[dict]:
-        flags = self.detector.check(read_heartbeats(self.dir))
+        by_rank = read_heartbeats(self.dir)
+        flags = self.detector.check(by_rank)
         now = time.monotonic()
-        for f in flags:
-            last = self._warned.get(f["rank"], -1e18)
-            if now - last < self.rewarn_after:
+        flagged = {f["rank"] for f in flags}
+        for r in list(self._incidents):
+            if r in flagged:
                 continue
-            self._warned[f["rank"]] = now
+            inc = self._incidents.pop(r)
+            recs = by_rank.get(r) or [{}]
+            last = recs[-1]
+            state = ("finished" if last.get("final") else
+                     f"back above floor at "
+                     f"{float(last.get('ex_per_sec', 0.0)):.0f} ex/s")
             self._emit(
-                f"[launcher] straggler: w{f['rank']} at "
-                f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
-                f"(median {f['median']:.0f}, factor "
-                f"{self.detector.factor})")
+                f"[launcher] recovered: w{r} {state} "
+                f"(incident #{inc['n']}, {now - inc['t0']:.0f}s)")
+        for f in flags:
+            r = f["rank"]
+            inc = self._incidents.get(r)
+            if inc is None:
+                n = self._incident_count.get(r, 0) + 1
+                self._incident_count[r] = n
+                self._incidents[r] = {"n": n, "t0": now, "warned": now}
+                self._emit(
+                    f"[launcher] straggler: w{r} at "
+                    f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
+                    f"(median {f['median']:.0f}, factor "
+                    f"{self.detector.factor}, incident #{n})")
+            elif now - inc["warned"] >= self.rewarn_after:
+                inc["warned"] = now
+                self._emit(
+                    f"[launcher] straggler: w{r} still at "
+                    f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
+                    f"({now - inc['t0']:.0f}s into incident "
+                    f"#{inc['n']})")
         return flags
 
     def _emit(self, msg: str) -> None:

@@ -1,0 +1,218 @@
+"""Cross-rank trace aggregation: one merged Perfetto file + skew report.
+
+Each rank of a multi-process run writes its own trace file
+(``obs._rank_path``: ``trace.json``, ``trace.r1.json``, ...) with
+timestamps relative to its own recorder base. This module aligns those
+files onto one timeline, merges them into a single Perfetto-viewable
+doc (per-rank ``pid`` tracks are already stamped by the recorder), and
+matches collective spans across ranks by their ``(site, seq)`` args
+(``parallel/collectives.py`` stamps a per-site sequence number into
+every sited collective span) to answer the straggler question the
+heartbeat warning can't: *who arrived last at each collective, and by
+how much*.
+
+Clock alignment: every heartbeat record carries both a wall (``ts``)
+and a monotonic (``mono``) timestamp sampled together, so each rank's
+wall<->monotonic offset is ``median(ts - mono)`` over its records. The
+merged timeline is ``mono_t0 + event_ts`` (the trace metadata carries
+``mono_t0``) plus the base rank's heartbeat offset — exact when ranks
+share a monotonic clock (``launch_mp``: one machine), and the per-rank
+offset *differences* are reported so cross-host wall skew is visible
+rather than silently folded in. Without heartbeats the per-rank
+``wall_t0`` anchors are used directly.
+
+The skew report is JSON: per-site skew aggregates (who was last, how
+often, worst/mean gap) and per-rank total lateness, with the worst
+offender named at top level — the launcher prints that line at exit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .heartbeat import read_heartbeats
+
+__all__ = ["load_rank_traces", "clock_offsets", "merge_traces",
+           "merge_run"]
+
+MERGED_TRACE = "merged.trace.json"
+SKEW_REPORT = "skew_report.json"
+
+
+def load_rank_traces(trace_dir: str) -> Dict[int, dict]:
+    """Rank -> trace doc for every per-rank trace file under
+    ``trace_dir``. A file counts when it parses as a trace-event doc
+    with recorder metadata; a previously merged output (tagged
+    ``metadata.merged``) is skipped so re-running is idempotent."""
+    out: Dict[int, dict] = {}
+    if not trace_dir or not os.path.isdir(trace_dir):
+        return out
+    for name in sorted(os.listdir(trace_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(trace_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or "traceEvents" not in doc:
+            continue
+        meta = doc.get("metadata") or {}
+        if meta.get("merged") or "rank" not in meta:
+            continue
+        out[int(meta["rank"])] = doc
+    return out
+
+
+def clock_offsets(by_rank: Dict[int, List[dict]]) -> Dict[int, float]:
+    """Per-rank wall-minus-monotonic offset, the median over heartbeat
+    records carrying both stamps (robust to one torn/laggy sample)."""
+    out: Dict[int, float] = {}
+    for rank, recs in by_rank.items():
+        diffs = sorted(float(r["ts"]) - float(r["mono"])
+                       for r in recs if "ts" in r and "mono" in r)
+        if diffs:
+            out[rank] = diffs[len(diffs) // 2]
+    return out
+
+
+def _unified_base(meta: dict, rank: int, offsets: Dict[int, float],
+                  base_rank: Optional[int]) -> float:
+    """Seconds added to a rank's relative event ts to place it on the
+    unified timeline (see module docstring for the clock model)."""
+    if base_rank is not None and rank in offsets:
+        return float(meta.get("mono_t0", 0.0)) + offsets[base_rank]
+    return float(meta.get("wall_t0", meta.get("mono_t0", 0.0)))
+
+
+def _collective_skew(arrivals_by_key: Dict[Tuple[str, int], Dict[int, float]]):
+    """Fold per-(site, seq) arrival times into the skew report body."""
+    sites: Dict[str, dict] = {}
+    per_rank: Dict[int, dict] = {}
+    matched = 0
+    for (site, _seq), arr in sorted(arrivals_by_key.items()):
+        if len(arr) < 2:
+            continue
+        matched += 1
+        first = min(arr.values())
+        last_rank = max(arr, key=lambda r: arr[r])
+        skew_ms = (arr[last_rank] - first) / 1e3
+        row = sites.setdefault(site, {"n": 0, "max_skew_ms": 0.0,
+                                      "sum_skew_ms": 0.0,
+                                      "last_counts": {}})
+        row["n"] += 1
+        row["max_skew_ms"] = max(row["max_skew_ms"], skew_ms)
+        row["sum_skew_ms"] += skew_ms
+        row["last_counts"][last_rank] = \
+            row["last_counts"].get(last_rank, 0) + 1
+        for r, t in arr.items():
+            late_ms = (t - first) / 1e3
+            pr = per_rank.setdefault(r, {"last_in": 0,
+                                         "total_lateness_ms": 0.0,
+                                         "max_lateness_ms": 0.0})
+            pr["total_lateness_ms"] += late_ms
+            pr["max_lateness_ms"] = max(pr["max_lateness_ms"], late_ms)
+            if r == last_rank:
+                pr["last_in"] += 1
+    for row in sites.values():
+        row["mean_skew_ms"] = round(row.pop("sum_skew_ms") / row["n"], 3)
+        row["max_skew_ms"] = round(row["max_skew_ms"], 3)
+    for pr in per_rank.values():
+        pr["total_lateness_ms"] = round(pr["total_lateness_ms"], 3)
+        pr["max_lateness_ms"] = round(pr["max_lateness_ms"], 3)
+    return sites, per_rank, matched
+
+
+def merge_traces(docs: Dict[int, dict],
+                 hb_by_rank: Optional[Dict[int, List[dict]]] = None
+                 ) -> Tuple[dict, dict]:
+    """Merge per-rank trace docs onto one timeline. Returns
+    ``(merged_doc, skew_report)``; both are plain JSON-serializable
+    dicts, writing is the caller's concern (:func:`merge_run`)."""
+    offsets = clock_offsets(hb_by_rank or {})
+    usable = [r for r in sorted(docs) if r in offsets]
+    base_rank = usable[0] if usable else None
+    merged_evs: List[dict] = []
+    arrivals: Dict[Tuple[str, int], Dict[int, float]] = {}
+    dropped: Dict[int, int] = {}
+    for rank in sorted(docs):
+        meta = docs[rank].get("metadata") or {}
+        dropped[rank] = int(meta.get("dropped_spans", 0))
+        base_us = _unified_base(meta, rank, offsets, base_rank) * 1e6
+        for ev in docs[rank]["traceEvents"]:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + base_us, 3)
+            merged_evs.append(ev)
+            args = ev.get("args") or {}
+            if (ev.get("ph") == "X" and ev.get("cat") == "collective"
+                    and "site" in args and "seq" in args):
+                arrivals.setdefault(
+                    (str(args["site"]), int(args["seq"])),
+                    {})[rank] = ev["ts"]
+    # rebase so the merged trace starts near zero (Perfetto renders
+    # absolute epoch-microsecond stamps, but small numbers read better)
+    stamped = [ev["ts"] for ev in merged_evs if "ts" in ev]
+    t_min = min(stamped) if stamped else 0.0
+    for ev in merged_evs:
+        if "ts" in ev:
+            ev["ts"] = round(ev["ts"] - t_min, 3)
+    for key in arrivals:
+        arrivals[key] = {r: t - t_min for r, t in arrivals[key].items()}
+    sites, per_rank, matched = _collective_skew(arrivals)
+    worst = None
+    if per_rank:
+        wr = max(per_rank, key=lambda r: per_rank[r]["total_lateness_ms"])
+        worst = {"rank": wr, "last_in": per_rank[wr]["last_in"],
+                 "of": matched,
+                 "lateness_ms": per_rank[wr]["total_lateness_ms"]}
+    report = {
+        "ranks": sorted(docs),
+        "clock_source": ("heartbeat" if base_rank is not None
+                         else "trace_wall_t0"),
+        # offset differences vs the base rank: nonzero means the ranks'
+        # wall clocks disagree (cross-host NTP skew made visible)
+        "clock_offset_s": {r: round(offsets[r] - offsets[base_rank], 6)
+                           for r in offsets} if base_rank is not None
+                          else {},
+        "dropped_spans": dropped,
+        "collectives_matched": matched,
+        "sites": sites,
+        "per_rank": per_rank,
+        "worst": worst,
+    }
+    merged = {"traceEvents": merged_evs, "displayTimeUnit": "ms",
+              "metadata": {"merged": True, "ranks": sorted(docs),
+                           "dropped_spans": dropped}}
+    return merged, report
+
+
+def _write_json(path: str, doc: dict) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_run(trace_dir: str, heartbeat_dir: str = "",
+              out_trace: str = "", out_report: str = ""
+              ) -> Optional[Tuple[str, dict]]:
+    """Gather every rank trace under ``trace_dir``, merge, and write
+    ``merged.trace.json`` + ``skew_report.json`` (or the given paths).
+    Returns ``(merged_trace_path, report)``, or None when no rank trace
+    exists — the launcher calls this unconditionally at exit."""
+    docs = load_rank_traces(trace_dir)
+    if not docs:
+        return None
+    hb = read_heartbeats(heartbeat_dir) if heartbeat_dir else {}
+    merged, report = merge_traces(docs, hb)
+    out_trace = out_trace or os.path.join(trace_dir, MERGED_TRACE)
+    out_report = out_report or os.path.join(trace_dir, SKEW_REPORT)
+    _write_json(out_trace, merged)
+    report["merged_trace"] = out_trace
+    _write_json(out_report, report)
+    report["report_path"] = out_report
+    return out_trace, report

@@ -322,12 +322,18 @@ class Registry:
         return out
 
     def prometheus_text(self, labels: Optional[dict] = None) -> str:
-        """Prometheus text exposition (version 0.0.4): HELP/TYPE headers
-        plus one sample per scalar, the cumulative ``_bucket`` series +
-        ``_count``/``_sum`` per histogram."""
+        """Prometheus text exposition (version 0.0.4): a ``# HELP`` and
+        ``# TYPE`` header per family (HELP from the declaration-site
+        help string, falling back to the metric name so a strict scraper
+        always sees both lines), then one sample per scalar and the
+        cumulative ``_bucket`` series + ``_count``/``_sum`` per
+        histogram. HELP text and label values are escaped per the
+        exposition-format rules."""
         lab = ""
         if labels:
-            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            inner = ",".join(
+                f'{k}="{_esc_label(str(v))}"'
+                for k, v in sorted(labels.items()))
             lab = "{" + inner + "}"
 
         def _san(name: str) -> str:
@@ -338,8 +344,7 @@ class Registry:
         for name in self.names():
             m = self._metrics[name]
             pname = _san(name)
-            if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# HELP {pname} {_esc_help(m.help or name)}")
             lines.append(f"# TYPE {pname} {m.kind}")
             if m.kind == "histogram":
                 cum = 0
@@ -354,6 +359,17 @@ class Registry:
             else:
                 lines.append(f"{pname}{lab} {m.value}")
         return "\n".join(lines) + "\n"
+
+
+def _esc_help(text: str) -> str:
+    """Exposition-format HELP escaping: backslash and newline only."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(text: str) -> str:
+    """Exposition-format label-value escaping: backslash, quote, LF."""
+    return (text.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def merge_snapshots(snaps: Sequence[dict]) -> Registry:
@@ -384,5 +400,9 @@ def encode_counters(reg: Optional[Registry] = None):
     counters), and blocks whose COO overflow exceeded ``ovf_cap`` and
     fell back to the audited scatter step."""
     reg = reg if reg is not None else default_registry()
-    return (reg.counter("feed/encode_stall"),
-            reg.counter("feed/tile_fallback_blocks"))
+    return (reg.counter("feed/encode_stall",
+                        help="seconds the stream waited on the online "
+                             "tile-encode workers"),
+            reg.counter("feed/tile_fallback_blocks",
+                        help="online-encoded blocks whose COO overflow "
+                             "fell back to the audited scatter step"))

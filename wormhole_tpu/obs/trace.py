@@ -41,7 +41,7 @@ from typing import Iterator, Optional
 
 __all__ = ["enable", "disable", "enabled", "configure", "complete",
            "span", "instant", "counter", "events", "summary", "reset",
-           "flush", "write_trace", "xla_profile"]
+           "dropped", "flush", "write_trace", "xla_profile"]
 
 # module-global fast path: `if not _ENABLED: return` is the entire cost
 # of every record call while tracing is off
@@ -50,6 +50,8 @@ _RING: "deque" = deque(maxlen=1)
 _PATH: Optional[str] = None
 _PID = 0
 _T0 = 0.0                      # monotonic base; ts are relative to it
+_WALL_T0 = 0.0                 # wall clock at _T0 (merge.py alignment)
+_DROPPED = 0                   # ring evictions since configure()
 _TID_NAMES: dict = {}          # tid -> thread name (first event wins)
 
 # event tuples: (ph, name, cat, ts_us, dur_us, tid, arg)
@@ -60,38 +62,48 @@ _PH_COUNTER = "C"
 
 def _rank() -> int:
     """Process rank without forcing a jax import: prefer an initialized
-    jax runtime, fall back to the launcher's PROCESS_ID env, then 0."""
+    multi-process jax runtime, fall back to the launcher's PROCESS_ID
+    env, then 0. A jax that never ran ``distributed.initialize`` reports
+    ``process_index() == 0`` in every launch_mp child, so its answer is
+    only trusted when the jax world is actually larger than one."""
     import sys
     j = sys.modules.get("jax")
     if j is not None:
         try:
-            return int(j.process_index())
+            if int(j.process_count()) > 1:
+                return int(j.process_index())
         except Exception:
             pass
     return int(os.environ.get("PROCESS_ID", "0"))
 
 
 def configure(trace_path: str = "", ring: int = 1 << 16,
-              enabled: Optional[bool] = None) -> None:
+              enabled: Optional[bool] = None,
+              pid: Optional[int] = None) -> None:
     """(Re)configure the global recorder. ``trace_path`` non-empty (or
     ``enabled=True`` for a ring-only, no-file session) turns tracing on;
-    both empty/False turns it off and drops buffered events."""
-    global _ENABLED, _RING, _PATH, _PID, _T0
+    both empty/False turns it off and drops buffered events. ``pid``
+    overrides the recorder's process rank (the Obs hub passes the rank
+    it was constructed with — authoritative over the env sniffing)."""
+    global _ENABLED, _RING, _PATH, _PID, _T0, _WALL_T0, _DROPPED
     on = bool(trace_path) if enabled is None else enabled
     _PATH = trace_path or None
     if on:
         _RING = deque(maxlen=max(int(ring), 16))
         _TID_NAMES.clear()
-        _PID = _rank()
+        _PID = _rank() if pid is None else int(pid)
         _T0 = time.monotonic()
+        _WALL_T0 = time.time()
+        _DROPPED = 0
     _ENABLED = on
     if not on:
         _RING = deque(maxlen=1)
         _TID_NAMES.clear()
 
 
-def enable(trace_path: str = "", ring: int = 1 << 16) -> None:
-    configure(trace_path, ring, enabled=True)
+def enable(trace_path: str = "", ring: int = 1 << 16,
+           pid: Optional[int] = None) -> None:
+    configure(trace_path, ring, enabled=True, pid=pid)
 
 
 def disable() -> None:
@@ -104,10 +116,17 @@ def enabled() -> bool:
 
 def _record(ph: str, name: str, cat: str, ts: float, dur: float,
             arg=None) -> None:
+    global _DROPPED
     t = threading.current_thread()
     tid = t.ident or 0
     if tid not in _TID_NAMES:
         _TID_NAMES[tid] = t.name
+    if len(_RING) == _RING.maxlen:
+        # the append below silently evicts the oldest event; count it so
+        # a truncated trace is detectable (summary counter + flush
+        # metadata). Approximate under racing writers — it's a tally,
+        # not an index.
+        _DROPPED += 1
     # deque.append is atomic under the GIL — no lock on the record path
     _RING.append((ph, name, cat, (ts - _T0) * 1e6, dur * 1e6, tid, arg))
 
@@ -191,6 +210,13 @@ def summary() -> dict:
     return agg
 
 
+def dropped() -> int:
+    """Ring evictions since :func:`configure` — events silently lost to
+    the bounded buffer. Cumulative across :func:`reset` (phase resets
+    keep the run-level truncation visible)."""
+    return _DROPPED
+
+
 def reset() -> None:
     _RING.clear()
 
@@ -199,14 +225,22 @@ def write_trace(path: str, evs: list) -> str:
     """Write ``evs`` (trace-event dicts, e.g. accumulated :func:`events`
     batches) plus the recorder's thread/process metadata as a Chrome
     trace-event JSON file (atomic tmp+replace). The bench uses this to
-    merge per-phase event batches into one viewable file."""
+    merge per-phase event batches into one viewable file.
+
+    The doc carries a ``metadata`` block (Perfetto ignores unknown
+    top-level keys): the recorder's rank, its monotonic/wall time bases
+    (obs/merge.py aligns per-rank files on these), and the drop count —
+    a nonzero ``dropped_spans`` marks the trace as truncated."""
     evs = list(evs)
     for tid, tname in sorted(_TID_NAMES.items()):
         evs.append({"ph": "M", "name": "thread_name", "pid": _PID,
                     "tid": tid, "args": {"name": tname}})
     evs.append({"ph": "M", "name": "process_name", "pid": _PID,
                 "args": {"name": f"wormhole-host{_PID}"}})
-    doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms",
+           "metadata": {"rank": _PID, "mono_t0": round(_T0, 6),
+                        "wall_t0": round(_WALL_T0, 6),
+                        "dropped_spans": _DROPPED}}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

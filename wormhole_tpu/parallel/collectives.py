@@ -23,7 +23,7 @@ as the versioned Checkpointer (parallel/checkpoint.py).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -95,6 +95,31 @@ def _exchange_leaf(chain, site, idx, x, op):
             for r in range(g.shape[0])]
 
 
+# per-site call counters stamped into collective span args: every rank
+# executes the same collective program, so the Nth call at a site is the
+# SAME logical collective on every rank — obs/merge.py matches spans
+# across rank trace files by (site, seq) to compute arrival skew. The
+# counter advances whether or not tracing is on (a late-enabled trace
+# must not desynchronize the numbering), and one counter covers all
+# collective kinds at a site (call order, not kind, is the identity).
+_SITE_SEQ: dict = {}
+
+
+def _stamp_seq(attrs) -> Optional[dict]:
+    if attrs is None:
+        return None
+    site = attrs["site"]
+    n = _SITE_SEQ.get(site, 0)
+    _SITE_SEQ[site] = n + 1
+    attrs["seq"] = n
+    return attrs
+
+
+def reset_site_seq() -> None:
+    """Forget per-site sequence numbers (tests / fresh logical runs)."""
+    _SITE_SEQ.clear()
+
+
 def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
                    compress: bool = False, site: str = None) -> Any:
     """Sum/max/min-allreduce a host-local pytree across the data-parallel
@@ -115,7 +140,7 @@ def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
     FIXING_FLOAT per ``site``."""
     # span recorded on the single-process fast path too: the boundary is
     # where the sync would be, which is what a trace reader looks for
-    attrs = {"site": site} if site else None
+    attrs = _stamp_seq({"site": site} if site else None)
     with trace.span(f"collective:allreduce_{op}", cat="collective",
                     args=attrs):
         if jax.process_count() == 1:
@@ -151,7 +176,7 @@ def allgather_tree(tree: Any, mesh: Mesh, site: str = None) -> Any:
     reduction, every rank's exact payload comes back) and books wire
     bytes like every other collective."""
     with trace.span("collective:allgather", cat="collective",
-                    args={"site": site} if site else None):
+                    args=_stamp_seq({"site": site} if site else None)):
         if jax.process_count() == 1:
             return jax.tree.map(lambda x: np.asarray(x)[None], tree)
         from jax.experimental import multihost_utils
@@ -174,7 +199,7 @@ def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
     (lossless stages only) — one extra length broadcast per leaf buys
     compressed payloads on the DCN hop."""
     with trace.span("collective:broadcast", cat="collective",
-                    args={"site": site} if site else None):
+                    args=_stamp_seq({"site": site} if site else None)):
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils

@@ -404,9 +404,14 @@ def _comm_counters():
     except Exception:
         return None
     reg = default_registry()
-    return (reg.counter("comm/bytes_raw"),
-            reg.counter("comm/bytes_wire"),
-            reg.counter("comm/filter_saved"))
+    return (reg.counter("comm/bytes_raw",
+                        help="collective payload bytes before the "
+                             "filter chain"),
+            reg.counter("comm/bytes_wire",
+                        help="collective payload bytes on the wire "
+                             "after the filter chain"),
+            reg.counter("comm/filter_saved",
+                        help="bytes the filter chain kept off the wire"))
 
 
 # ---------------------------------------------------------------------------

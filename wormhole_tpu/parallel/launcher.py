@@ -86,13 +86,15 @@ def _pump_lines(stream, sink, lock, tag: bytes = b"") -> None:
 
 
 def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
-              straggler_factor: float = 3.0) -> int:
+              straggler_factor: float = 3.0, trace_dir: str = "") -> int:
     import threading
     port = _free_port()
     procs = []
     pumps = []
     out_lock = threading.Lock()
     monitor = None
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
     if heartbeat_dir:
         # children inherit the export dir (obs.setup falls back to this
         # env var), the launcher watches their heartbeat files and warns
@@ -121,6 +123,10 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
         env["PROCESS_ID"] = str(i)
         if heartbeat_dir:
             env["WORMHOLE_METRICS_EXPORT"] = heartbeat_dir
+        if trace_dir:
+            # workers trace into per-rank files under this directory
+            # (obs.setup fallback); the launcher merges them at exit
+            env["WORMHOLE_TRACE_EXPORT"] = trace_dir
         p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE)
         procs.append(p)
@@ -167,7 +173,40 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
             t.join(timeout=10)
         if monitor is not None:
             monitor.stop()
+        if trace_dir:
+            _merge_rank_traces(trace_dir, heartbeat_dir, out_lock)
     return rc
+
+
+def _merge_rank_traces(trace_dir: str, heartbeat_dir: str,
+                       out_lock) -> None:
+    """Exit-time aggregation: merge the ranks' trace files into one
+    Perfetto doc + collective-skew report (obs/merge.py) and print the
+    straggler attribution line. Best-effort — a merge failure must not
+    change the job's exit code."""
+    def emit(msg: str) -> None:
+        with out_lock:
+            sys.stderr.write(msg + "\n")
+            sys.stderr.flush()
+
+    try:
+        from wormhole_tpu.obs import merge as _merge
+        res = _merge.merge_run(trace_dir, heartbeat_dir)
+        if res is None:
+            emit(f"[launcher] no rank traces under {trace_dir}; "
+                 "merge skipped")
+            return
+        merged_path, report = res
+        emit(f"[launcher] merged trace: {merged_path} "
+             f"({report['collectives_matched']} matched collectives, "
+             f"report: {report['report_path']})")
+        w = report.get("worst")
+        if w:
+            emit(f"[launcher] collective skew: w{w['rank']} last in "
+                 f"{w['last_in']}/{w['of']} collectives, total "
+                 f"lateness {w['lateness_ms']:.1f} ms")
+    except Exception as e:
+        emit(f"[launcher] trace merge failed: {e!r}")
 
 
 def launch_tpu(cmd: List[str]) -> int:
@@ -193,6 +232,12 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--straggler-factor", type=float, default=3.0,
                     help="warn when a worker's ex/s falls below "
                          "median/FACTOR (with --heartbeat-dir)")
+    ap.add_argument("--trace-dir", default="",
+                    help="mp only: trace directory exported to workers "
+                         "(WORMHOLE_TRACE_EXPORT); each rank traces "
+                         "into it and the launcher merges the files at "
+                         "exit into merged.trace.json + a collective "
+                         "skew report")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- command to launch")
     args = ap.parse_args(argv)
@@ -204,7 +249,8 @@ def main(argv: List[str] = None) -> int:
     run = {"sim": lambda: launch_sim(args.num_devices, cmd),
            "mp": lambda: launch_mp(args.num_devices, cmd,
                                    heartbeat_dir=args.heartbeat_dir,
-                                   straggler_factor=args.straggler_factor),
+                                   straggler_factor=args.straggler_factor,
+                                   trace_dir=args.trace_dir),
            "tpu": lambda: launch_tpu(cmd)}[args.cluster]
     rc = run()
     attempt = 0

@@ -974,6 +974,170 @@ def bench_scale_curve(workdir: str, rng) -> list:
     return out
 
 
+def bench_serve() -> dict:
+    """Online serving (wormhole_tpu/serve): fixed-QPS open-loop client
+    against the admission-batching front-end, solo and co-resident with
+    a live training loop on the same chip.
+
+    Open-loop means arrival times are fixed in advance (t0 + i/qps) and
+    never wait on responses — the honest way to measure a latency SLO,
+    since a closed-loop client self-throttles exactly when the server
+    is slow (coordinated omission). Reported per stage: exact p50/p99
+    request latency and achieved QPS. Mid-phase the checkpoint poller
+    hot-swaps a new model version under load; the compile counter must
+    stay at 1 (one geometry = one compile, swaps retrace nothing). The
+    co-resident stage runs training ticks on the main thread while the
+    client submits from another — the train-rate ratio vs. solo is the
+    interference number docs/serving.md budgets."""
+    import jax
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.obs.metrics import Registry
+    from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.parallel.checkpoint import Checkpointer
+    from wormhole_tpu.serve import (ForwardStep, ServeFrontend,
+                                    ServeRunner, SnapshotPoller)
+    import threading
+
+    nb = 1 << 16
+    qps = 400.0
+    stage_reqs = 1200            # ~3s of open-loop traffic per stage
+    batch_rows, max_nnz, deadline_ms = 64, 32, 5.0
+    rng = np.random.default_rng(11)
+    store = ShardedStore(StoreConfig(num_buckets=nb, loss="logit"),
+                         FTRLHandle(penalty=L1L2(1.0, 0.1),
+                                    lr=LearnRate(0.1, 1.0)))
+    reg = Registry()
+
+    # a training minibatch for the co-resident loop (and the mid-phase
+    # model delta the swap must make visible)
+    train_batch = jax.device_put(make_serve_train_batch(rng, nb))
+
+    def train_tick():
+        m = store.train_step(train_batch, tau=0.0)
+        jax.block_until_ready(m)
+
+    train_tick()                 # compile the train step outside timing
+    # the serving tier owns a SNAPSHOT, never the live table: the fused
+    # train step donates its slots buffer, so an alias of the live array
+    # dies on the next tick — the poller's first load is what gives the
+    # forward an independent model to serve
+    fwd = ForwardStep.from_store(store)
+    reqs = [rng.choice(nb, size=int(rng.integers(8, max_nnz)),
+                       replace=False) for _ in range(stage_reqs)]
+
+    def open_loop(fe, n0, n1) -> dict:
+        t0 = time.perf_counter()
+        pending = []
+        for i in range(n0, n1):
+            target = t0 + (i - n0) / qps
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            pending.append(fe.submit(reqs[i]))
+        for r in pending:
+            r.result(timeout=30)
+        return {"n": n1 - n0,
+                "achieved_qps": (n1 - n0) / (time.perf_counter() - t0)}
+
+    workdir = tempfile.mkdtemp(prefix="wh_bench_serve_")
+    ckpt = Checkpointer(workdir, is_writer=True)
+    template = jax.tree.map(np.asarray, store.state_pytree())
+    ckpt.save(1, store.state_pytree())
+
+    out = {"qps_target": qps, "batch_rows": batch_rows,
+           "deadline_ms": deadline_ms}
+    # -- stage 1: solo serving, hot-swap at half-traffic ------------------
+    fe = ServeFrontend(fwd, batch_rows=batch_rows, max_nnz=max_nnz,
+                       deadline_ms=deadline_ms, registry=reg)
+    poller = SnapshotPoller(ckpt, template, fwd, poll_itv=0.1)
+    assert poller.poll_once(), "v1 snapshot must load before traffic"
+    poller.start()
+    fe.submit(reqs[0]).result(timeout=30)   # compile outside the window
+    half = stage_reqs // 2
+    a1 = open_loop(fe, 0, half)
+    train_tick()                            # move the model, commit v2
+    ckpt.save(2, store.state_pytree())
+    a2 = open_loop(fe, half, stage_reqs)
+    # the poller runs every 0.1s; the second half of traffic takes ~1.5s
+    deadline = time.perf_counter() + 5.0
+    while poller.swaps == 0 and time.perf_counter() < deadline:
+        time.sleep(0.05)
+    poller.stop()
+    solo = fe.stats()
+    fe.close()
+    solo["achieved_qps"] = round(
+        (a1["n"] + a2["n"]) / (a1["n"] / a1["achieved_qps"]
+                               + a2["n"] / a2["achieved_qps"]), 1)
+    out["solo"] = solo
+    out["hot_swap"] = {"swaps": poller.swaps,
+                       "serving_version": poller.version,
+                       "recompiles": fwd.compiles - 1}
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+
+    # -- stage 2: train-rate baseline (no serving traffic) ----------------
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 1.5:
+        train_tick()
+        n += 1
+    out["train_solo_steps_per_sec"] = round(n / (time.perf_counter() - t0),
+                                            1)
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+
+    # -- stage 3: co-resident serve + train on the same chip --------------
+    fe = ServeFrontend(fwd, batch_rows=batch_rows, max_nnz=max_nnz,
+                       deadline_ms=deadline_ms, registry=reg)
+    runner = ServeRunner(fe, train_tick=train_tick)
+    co: dict = {}
+    client = threading.Thread(
+        target=lambda: co.update(open_loop(fe, 0, stage_reqs)),
+        daemon=True)
+    t0 = time.perf_counter()
+    client.start()
+    while client.is_alive():
+        runner.run(seconds=0.2)
+    client.join()
+    co_steps = runner.train_steps / (time.perf_counter() - t0)
+    cores = fe.stats()
+    runner.close()
+    cores["achieved_qps"] = round(co["achieved_qps"], 1)
+    cores["train_steps_per_sec"] = round(co_steps, 1)
+    out["coresident"] = cores
+    out["train_interference_frac"] = round(
+        1.0 - co_steps / max(out["train_solo_steps_per_sec"], 1e-9), 4)
+    out["serve_recompiles_total"] = fwd.compiles - 1
+    for fn in os.listdir(workdir):
+        try:
+            os.remove(os.path.join(workdir, fn))
+        except OSError:
+            pass
+    try:
+        os.rmdir(workdir)
+    except OSError:
+        pass
+    return out
+
+
+def make_serve_train_batch(rng, nb: int):
+    """A small sparse train minibatch for the serve phase's co-resident
+    training loop (full-size MINIBATCH would dwarf the serve forwards)."""
+    from wormhole_tpu.data.feed import SparseBatch
+    mb, nnz, k = 4096, 32, 8192
+    uniq = np.zeros(k, np.int32)
+    uniq[:k] = np.sort(rng.choice(nb, size=k, replace=False))
+    cols = rng.integers(0, k, size=(mb, nnz)).astype(np.int32)
+    vals = np.ones((mb, nnz), np.float32)
+    labels = (rng.random(mb) < 0.25).astype(np.float32)
+    return SparseBatch(cols=cols, vals=vals, labels=labels,
+                       row_mask=np.ones(mb, np.float32), uniq_keys=uniq,
+                       key_mask=np.ones(k, np.float32))
+
+
 # ordered phase registry; headline phases first so a tight budget still
 # produces the metric. Phases needing the shared tile stores / the crec2
 # file / the text file are tagged so a filtered run only builds what it
@@ -981,7 +1145,8 @@ def bench_scale_curve(workdir: str, rng) -> list:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "device_sparse", "device_dense_apply",
-          "scale_curve", "comm_filters", "kmeans", "lbfgs", "gbdt"]
+          "scale_curve", "serve", "comm_filters", "kmeans", "lbfgs",
+          "gbdt"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1073,6 +1238,12 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
             results["channel_ratios"]
     if "scale_curve" in results:
         extra["scale_curve_tile_step"] = results["scale_curve"]
+    if "serve" in results:
+        def _round_serve(v):
+            if isinstance(v, dict):
+                return {k: _round_serve(x) for k, x in v.items()}
+            return round(v, 2) if isinstance(v, float) else v
+        extra["serve"] = _round_serve(results["serve"])
     if "comm_filters" in results:
         extra["comm_filters"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
@@ -1202,6 +1373,7 @@ def main(argv=None) -> None:
         "device_sparse": bench_device_sparse,
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
+        "serve": bench_serve,
         "comm_filters": bench_comm_filters,
         "kmeans": bench_kmeans,
         "lbfgs": bench_lbfgs,

@@ -17,6 +17,10 @@ regresses against its predecessor:
 - **Headline**: ``parsed.value`` is compared only when the two runs'
   ``metric`` names match (r01 reports ``ftrl_async_sgd_examples_per_sec``,
   later runs ``end_to_end_examples_per_sec`` — not comparable).
+- **Latency** (lower is better): every numeric ``*p50_ms`` / ``*p99_ms``
+  key (the serve phase's tail-latency SLO numbers) must not GROW above
+  ``prev * (1 + tol)`` at the same dotted path — a p99 regression gates
+  just like a throughput drop, with the inequality flipped.
 - **Ledger fractions**: when both runs carry a ledger block (bench.py
   ``--out`` telemetry, ``{"ledger": {"frac": {...}}}`` anywhere under
   ``parsed``), the ``unattributed`` and ``residual_stall`` fractions may
@@ -46,6 +50,11 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 _RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
+# lower-is-better keys: serve-phase tail latencies. Deliberately NOT
+# `*_ms$` — step_ms etc. are derived from the throughput keys already
+# gated above, and double-gating one measurement would double the noise
+# exposure.
+_LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
 
 
@@ -71,12 +80,13 @@ def load_runs(bench_dir: str) -> List[Tuple[str, Optional[dict]]]:
     return out
 
 
-def rate_keys(parsed: dict) -> Dict[str, float]:
-    """dotted-path -> value for every numeric throughput key under
-    ``parsed``. Paths (not bare leaf names) keep r02's ``e2e.ex_per_sec``
-    distinct from r03's ``e2e_steady_cached.ex_per_sec`` — different
-    benchmarks, never compared."""
-    rates: Dict[str, float] = {}
+def _keys_matching(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
+    """dotted-path -> value for every numeric key under ``parsed`` whose
+    leaf name matches ``pat``. Paths (not bare leaf names) keep r02's
+    ``e2e.ex_per_sec`` distinct from r03's
+    ``e2e_steady_cached.ex_per_sec`` — different benchmarks, never
+    compared."""
+    found: Dict[str, float] = {}
 
     def walk(node, path: str) -> None:
         if not isinstance(node, dict):
@@ -86,10 +96,20 @@ def rate_keys(parsed: dict) -> Dict[str, float]:
             if isinstance(v, dict):
                 walk(v, p)
             elif isinstance(v, (int, float)) and not isinstance(v, bool) \
-                    and _RATE_PAT.search(k):
-                rates[p] = float(v)
+                    and pat.search(k):
+                found[p] = float(v)
     walk(parsed, "")
-    return rates
+    return found
+
+
+def rate_keys(parsed: dict) -> Dict[str, float]:
+    """Throughput keys (higher is better) under ``parsed``."""
+    return _keys_matching(parsed, _RATE_PAT)
+
+
+def latency_keys(parsed: dict) -> Dict[str, float]:
+    """Tail-latency keys (LOWER is better) under ``parsed``."""
+    return _keys_matching(parsed, _LAT_PAT)
 
 
 def ledger_fracs(parsed: dict) -> Dict[str, float]:
@@ -135,6 +155,16 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
             bad.append(
                 f"{key}: {cv:.1f} < {pv:.1f} * {1 - tol:.2f} "
                 f"({cv / pv:.2f}x, {cur_name} vs {prev_name})")
+    plats, clats = latency_keys(prev), latency_keys(cur)
+    for key in sorted(set(plats) & set(clats)):
+        pv, cv = plats[key], clats[key]
+        if pv <= 0:
+            continue
+        if cv > pv * (1.0 + tol):
+            bad.append(
+                f"{key}: {cv:.1f}ms > {pv:.1f}ms * {1 + tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
+                "serve tail latency regression")
     pfracs, cfracs = ledger_fracs(prev), ledger_fracs(cur)
     for key in sorted(set(pfracs) & set(cfracs)):
         if cfracs[key] > pfracs[key] + tol_frac:
@@ -157,6 +187,7 @@ def run(bench_dir: str, tol: float, tol_frac: float,
     compared = 0
     for (pn, pp), (cn, cp) in pairs:
         compared += len(set(rate_keys(pp)) & set(rate_keys(cp)))
+        compared += len(set(latency_keys(pp)) & set(latency_keys(cp)))
         failures.extend(compare(pn, pp, cn, cp, tol, tol_frac))
     if failures:
         print(f"bench_check: {len(failures)} regression(s):",
@@ -165,7 +196,7 @@ def run(bench_dir: str, tol: float, tol_frac: float,
             print(f"  {msg}", file=sys.stderr)
         return 1
     print(f"bench_check: OK ({len(pairs)} pair(s), {compared} shared "
-          f"throughput keys, tol {tol:.0%}, ledger tol "
+          f"throughput/latency keys, tol {tol:.0%}, ledger tol "
           f"+{tol_frac:.2f})")
     return 0
 

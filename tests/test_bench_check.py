@@ -148,3 +148,41 @@ def test_real_trajectory_with_injected_drop_fails(tmp_path):
     r = _run("--dir", d)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "regression" in r.stderr
+
+
+def test_latency_regression_fails(tmp_path):
+    # serve tail latencies gate LOWER-is-better: growth past tol fails
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 8.0,
+                                                 "p50_ms": 3.0}}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 20.0,
+                                                 "p50_ms": 3.1}}}))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "serve.solo.p99_ms" in r.stderr
+    assert "tail latency" in r.stderr
+    # the healthy p50 is not reported
+    assert "p50_ms" not in r.stderr
+
+
+def test_latency_within_tolerance_passes(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 10.0}}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 12.0}}}))
+    r = _run("--dir", d)   # +20% < default 25% tol
+    assert r.returncode == 0, r.stderr
+    assert _run("--dir", d, "--tol", "0.1").returncode == 1
+
+
+def test_latency_improvement_never_fails(tmp_path):
+    # lower-is-better means a big DROP in latency is pure win
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 50.0}}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"serve": {"solo": {"p99_ms": 5.0}}}))
+    assert _run("--dir", d).returncode == 0

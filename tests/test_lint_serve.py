@@ -1,0 +1,83 @@
+"""The serve lint (scripts/lint_serve.py) enforces the pull-only
+contract of PR 7: nothing under wormhole_tpu/serve/ may reach a
+push/update/optimizer entry point or scatter into a parameter table.
+The real package must pass; synthetic violations of each forbidden
+pattern class must fail with file:line diagnostics."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "lint_serve.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True)
+
+
+def test_repo_serve_package_is_pull_only():
+    r = _run("--root", REPO)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    assert "pull-only" in r.stdout
+
+
+def test_missing_package_is_distinct_rc(tmp_path):
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 2
+
+
+def test_push_call_caught(tmp_path):
+    pkg = tmp_path / "wormhole_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def f(store, slots, grad, t, tau):\n"
+        "    # a comment saying .push( must NOT trip the lint\n"
+        "    return store.handle.push(slots, grad, t, tau)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "wormhole_tpu/serve/bad.py:3" in r.stderr
+    assert "pull-only" in r.stderr
+
+
+def test_train_step_and_scatter_caught(tmp_path):
+    pkg = tmp_path / "wormhole_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def f(store, batch, x, i, v):\n"
+        "    m = store.train_step(batch)\n"
+        "    return x.at[\n"
+        "        i\n"
+        "    ].add(v)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "wormhole_tpu/serve/bad.py:2" in r.stderr   # train_step
+    assert "wormhole_tpu/serve/bad.py:3" in r.stderr   # multiline scatter
+
+
+def test_pull_only_code_passes(tmp_path):
+    pkg = tmp_path / "wormhole_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "fine.py").write_text(
+        "def f(store, params, batch):\n"
+        "    # pull + margin + a benign .set (not a scatter-add)\n"
+        "    rows = params['slots'][batch.uniq_keys]\n"
+        "    w = store.handle.weights(rows)\n"
+        "    buf = rows.at[0].set(0.0)\n"
+        "    return w, buf\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0, r.stderr
+
+
+def test_files_outside_serve_not_scanned(tmp_path):
+    # the training stores legitimately push; the lint's scope is serve/
+    pkg = tmp_path / "wormhole_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "learners").mkdir()
+    (pkg / "learners" / "store.py").write_text(
+        "def f(h, s, g, t, tau):\n"
+        "    return h.push(s, g, t, tau)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0, r.stderr

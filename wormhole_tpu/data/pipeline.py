@@ -207,6 +207,27 @@ class DeviceFeed:
         import jax
         return jax.device_put
 
+    def prepare(self, item: Any, ctx: Any = None):
+        """Run ONE item through prep + transfer inline and return the
+        device-resident result — the pad/transfer machinery as a
+        callable instead of a stream. The serving front-end drives the
+        pipeline in reverse with this: requests arrive *from* callers
+        rather than being pulled from a source, so admission owns the
+        loop and hands each flush group here for the same prep/put
+        accounting (and trace spans) a streaming feed gets. No collate,
+        no on_close: one item in, one device item out."""
+        mono = time.monotonic
+        transfer = self._default_transfer()
+        t0 = mono()
+        res = self.prep(item, ctx) if self.prep else item
+        self._acc(self._busy, "prep", mono() - t0)
+        t0 = mono()
+        out = transfer(res)
+        self._acc(self._busy, "put", mono() - t0)
+        with self._lock:
+            self._batches += 1
+        return out
+
     def _iter_serial(self):
         """Inline fallback: every stage on the consumer thread, same
         order/exception semantics, no threads (``pipeline_workers=0``)."""

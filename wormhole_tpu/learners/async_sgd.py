@@ -38,7 +38,7 @@ from wormhole_tpu.learners.store import ShardedStore, StoreConfig
 from wormhole_tpu.ops.penalty import L1L2
 from wormhole_tpu.ops.tilemm import PADWORD
 from wormhole_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
-from wormhole_tpu.sched.workload_pool import (TRAIN, VAL,
+from wormhole_tpu.sched.workload_pool import (TEST, TRAIN, VAL,
                                               ReplicatedRounds,
                                               WorkloadPool)
 from wormhole_tpu.utils.config import Config
@@ -124,6 +124,10 @@ class AsyncSGD:
         from wormhole_tpu.parallel.checkpoint import Checkpointer
         self.ckpt = Checkpointer(cfg.checkpoint_dir)
         self._warned_ckpt = False
+        # pull-only forward for predict() (serve/forward.py), built on
+        # demand per predict pass when cfg.serve_predict and the store
+        # has the serve surface; None routes TEST through eval_step
+        self._predict_forward = None
         # telemetry hub (obs/): trace_path turns span tracing on,
         # metrics_export turns heartbeat/Prometheus files on; both off
         # (the default) leaves every instrumented path at one bool check
@@ -314,6 +318,17 @@ class AsyncSGD:
                     m = self.store.train_step(batch,
                                               tau=float(len(inflight)))
                     inflight.append((m, None, None))
+                elif kind == TEST and self._predict_forward is not None:
+                    # offline predict rides the online serving forward
+                    # (serve/forward.py): same pull-only margin function
+                    # the serving tier compiles, exercised on every
+                    # batch-predict run. Eval metrics are meaningless on
+                    # unlabeled TEST data, so only the margin is real;
+                    # eval_step remains the metrics oracle for VAL.
+                    margin = self._predict_forward.margins(batch)
+                    keep = self._real_rows(batch)
+                    m = (0.0, float((keep >= 0).sum()), 0.5, 0.0, margin)
+                    inflight.append((m, np.asarray(batch.labels), keep))
                 else:
                     m = self.store.eval_step(batch)
                     keep = self._real_rows(batch)
@@ -1520,7 +1535,6 @@ class AsyncSGD:
             self.ckpt_version = completed
             ckpt.save(completed, self.store.state_pytree())
         if cfg.test_data:
-            from wormhole_tpu.sched.workload_pool import TEST
             pooled = []
             if crec:
                 self._multihost_pass_crec(cfg.test_data, TEST, pooled)
@@ -1626,18 +1640,25 @@ class AsyncSGD:
         the test data, write one prediction per real row to ``pred_out`` —
         σ(margin) for logit loss (linear.h MarginToPred), the raw margin
         otherwise."""
-        from wormhole_tpu.sched.workload_pool import TEST
         if not out_path:
             raise ValueError("test_data set but pred_out empty")
+        if self.cfg.serve_predict and hasattr(self.store,
+                                              "build_serve_margin"):
+            from wormhole_tpu.serve import ForwardStep
+            self._predict_forward = ForwardStep.from_store(self.store)
         pool = WorkloadPool()
         pool.add(pattern, self.cfg.num_parts_per_file, TEST)
         pooled: list = []
-        while True:
-            wl = pool.get("predict")
-            if wl is None:
-                break
-            self.process(wl.file, wl.part, wl.nparts, TEST, pooled=pooled)
-            pool.finish(wl.id)
+        try:
+            while True:
+                wl = pool.get("predict")
+                if wl is None:
+                    break
+                self.process(wl.file, wl.part, wl.nparts, TEST,
+                             pooled=pooled)
+                pool.finish(wl.id)
+        finally:
+            self._predict_forward = None
         self._write_preds(pooled, out_path)
 
     # -- observability ------------------------------------------------------

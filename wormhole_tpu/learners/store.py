@@ -337,13 +337,40 @@ class ShardedStore(TableCheckpoint):
 
         return step
 
+    # -- pull-only serving surface ------------------------------------------
+    #
+    # The inference half of the ZPush/ZPull pair (serve/): margins as a
+    # pure function of caller-owned params, so a hot-swapped snapshot can
+    # replace the model without touching the training store. _build_eval
+    # routes through the same function — eval and serve share ONE audited
+    # margin computation (the bit-equality the serve tests pin).
+
+    def serve_params(self):
+        """Live model params for the pull-only forward (serve/forward.py).
+        Keys must match state_pytree's so a checkpoint restores straight
+        into a serve swap."""
+        return {"slots": self.slots}
+
+    def build_serve_margin(self):
+        """margin_fn(params, batch) -> (mb,) margins: pull (gather) +
+        weights + spmv, nothing else — no push, no optimizer state, no
+        metric work. Jit-compiled by the caller, once per geometry."""
+        handle = self.handle
+
+        def margin_fn(params, batch: SparseBatch):
+            rows = params["slots"][batch.uniq_keys].astype(jnp.float32)
+            w = handle.weights(rows)
+            return spmv_times(batch.cols, batch.vals, w)
+
+        return margin_fn
+
     def _build_eval(self):
-        handle, objv_fn = self.handle, self.objv_fn
+        objv_fn = self.objv_fn
+        margin_fn = self.build_serve_margin()
 
         @jax.jit
         def ev(slots, batch: SparseBatch):
-            w = handle.weights(slots[batch.uniq_keys].astype(jnp.float32))
-            margin = spmv_times(batch.cols, batch.vals, w)
+            margin = margin_fn({"slots": slots}, batch)
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)

@@ -123,14 +123,27 @@ class FMStore(TableCheckpoint):
 
         return step
 
-    def _build_eval(self):
+    # -- pull-only serving surface (serve/forward.py; see ShardedStore) -----
+
+    def serve_params(self):
+        return {"slots": self.slots}
+
+    def build_serve_margin(self):
         k = self.cfg.dim
+
+        def margin_fn(params, batch: SparseBatch):
+            theta = params["slots"][batch.uniq_keys][:, :1 + k]
+            return fm_margin(theta, batch)
+
+        return margin_fn
+
+    def _build_eval(self):
         objv_fn = self.objv_fn
+        margin_fn = self.build_serve_margin()
 
         @jax.jit
         def ev(slots, batch: SparseBatch):
-            theta = slots[batch.uniq_keys][:, :1 + k]
-            margin = fm_margin(theta, batch)
+            margin = margin_fn({"slots": slots}, batch)
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)

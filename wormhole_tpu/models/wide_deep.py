@@ -149,15 +149,28 @@ class WideDeepStore(TableCheckpoint):
 
         return step
 
-    def _build_eval(self):
+    # -- pull-only serving surface (serve/forward.py; see ShardedStore) -----
+
+    def serve_params(self):
+        return {"slots": self.slots, "mlp": self.mlp}
+
+    def build_serve_margin(self):
         k = self.cfg.dim
-        objv_fn = self.objv_fn
         forward = self._forward
+
+        def margin_fn(params, batch: SparseBatch):
+            theta = params["slots"][batch.uniq_keys][:, :1 + k]
+            return forward(theta, params["mlp"], batch)
+
+        return margin_fn
+
+    def _build_eval(self):
+        objv_fn = self.objv_fn
+        margin_fn = self.build_serve_margin()
 
         @jax.jit
         def ev(slots, mlp, batch: SparseBatch):
-            theta = slots[batch.uniq_keys][:, :1 + k]
-            margin = forward(theta, mlp, batch)
+            margin = margin_fn({"slots": slots, "mlp": mlp}, batch)
             objv = objv_fn(margin, batch.labels, batch.row_mask)
             num_ex = jnp.sum(batch.row_mask)
             a = auc(batch.labels, margin, batch.row_mask)

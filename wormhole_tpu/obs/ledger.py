@@ -90,6 +90,10 @@ SPAN_TABLE: Dict[str, str] = {
     # attributable but outside the step loop proper
     "checkpoint:*": "other",
     "gbdt:chunk_read": "other",
+    # online serving (serve/): the pull-only forward is device work;
+    # the snapshot hot-swap is a reference assignment outside any step
+    "serve:forward": "device_compute",
+    "serve:swap": "other",
 }
 
 # DeviceFeed stage -> bucket, for dynamic ``<feed>:<stage>`` span names

@@ -82,6 +82,13 @@ class Checkpointer:
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
+                # fsync before the rename: os.replace alone makes the
+                # *name* atomic but not the *bytes* durable — after a
+                # power cut the new name can point at a truncated file,
+                # which the serving snapshot poller would then try to
+                # load. fsync orders data before the rename commit.
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         self._gc(version)
 

@@ -1,0 +1,35 @@
+"""Online serving: pull-only inference against the training stores.
+
+The reference serves CTR predictions from ps-lite workers that issue
+pull-only reads against the same key-value store the trainers push into
+(PAPER.md; async_sgd.h:84-117 ZPull without the ZPush half). The SPMD
+equivalent lives here:
+
+- :mod:`.forward` — the pull-only forward step: tile pull + margin +
+  sigmoid as a pure function of caller-owned params, compiled once per
+  (store, geometry) and shared by the linear/FM/wide&deep stores via
+  their ``build_serve_margin`` surface (the same audited margin
+  computation ``_build_eval`` runs);
+- :mod:`.frontend` — admission batching: a thread-safe request queue
+  aggregating micro-requests into fixed-shape device batches under a
+  ``serve_deadline_ms`` latency budget, riding the DeviceFeed
+  pad/transfer machinery in reverse (``DeviceFeed.prepare``);
+- :mod:`.snapshot` — checkpoint hot-swap: poll ``parallel/checkpoint``
+  for a new version, load into a standby pytree with identical avals,
+  swap atomically between batches (zero recompiles, no torn reads),
+  plus a :class:`~.snapshot.ServeRunner` that co-schedules serving
+  against a live training loop on the same chip.
+
+The pull-only contract — nothing under this package may call a
+push/update/optimizer entry point — is enforced statically by
+``scripts/lint_serve.py``. See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from .forward import ForwardStep
+from .frontend import ServeFrontend, serve_metrics
+from .snapshot import SnapshotPoller, ServeRunner
+
+__all__ = ["ForwardStep", "ServeFrontend", "serve_metrics",
+           "SnapshotPoller", "ServeRunner"]

@@ -1,0 +1,147 @@
+"""Pull-only forward step: margins + predictions from caller-owned params.
+
+The training stores fuse pull -> forward -> backward -> push into one
+jitted step; serving needs exactly the first half, against a model the
+serving tier OWNS (a hot-swapped snapshot), not the live training table.
+:class:`ForwardStep` closes over a store's ``build_serve_margin`` —
+the same margin function ``_build_eval`` compiles, so serve and eval
+share one audited computation — and jits
+
+    (params, batch) -> (margin, prediction)
+
+once per (store, geometry). ``params`` is a plain pytree ({"slots": ...}
+for the linear and FM stores, + "mlp" for wide&deep) held behind a lock:
+:meth:`swap` replaces it atomically between batches, and refuses any
+replacement whose avals differ from the current model — an aval change
+would silently retrace, and serving must never recompile mid-traffic
+(the compile counter :attr:`compiles` pins that in tests and bench).
+
+For crec2 tile blocks, :func:`tile_margins` routes through the store's
+already-cached tile eval executable (``_tile_step(info, "eval")``) —
+the tile pull machinery of ``tile_train_step`` without the push half,
+and zero additional compilations when serving co-resides with eval.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wormhole_tpu.data.feed import SparseBatch
+
+__all__ = ["ForwardStep", "tile_margins"]
+
+
+def _aval(x) -> tuple:
+    x = jnp.asarray(x) if not hasattr(x, "shape") else x
+    return (tuple(x.shape), jnp.dtype(x.dtype).name)
+
+
+class ForwardStep:
+    """One compiled pull-only forward shared by every serve consumer.
+
+    ``margin_fn(params, batch) -> (mb,) margins`` comes from the store's
+    ``build_serve_margin``; ``loss == "logit"`` adds the sigmoid (the
+    reference's MarginToPred, linear.h), other losses serve the raw
+    margin — matching ``AsyncSGD._write_preds``.
+    """
+
+    def __init__(self, margin_fn: Callable[[Any, SparseBatch], jax.Array],
+                 params: Any, loss: str = "logit") -> None:
+        self._lock = threading.Lock()
+        self._params = params
+        self.loss = loss
+        self.compiles = 0
+        sigmoid = loss == "logit"
+
+        def fwd(p, batch: SparseBatch):
+            # runs only when jit (re)traces: traces == compilations for
+            # this function, so the counter pins "zero recompiles" in
+            # tests without reaching into jit internals
+            self.compiles += 1
+            margin = margin_fn(p, batch)
+            pred = jax.nn.sigmoid(margin) if sigmoid else margin
+            return margin, pred
+
+        self._fwd = jax.jit(fwd)
+
+    @classmethod
+    def from_store(cls, store, loss: Optional[str] = None) -> "ForwardStep":
+        """Build from any store with the serve surface
+        (``build_serve_margin`` + ``serve_params``).
+
+        The initial params ALIAS the store's live arrays — safe when
+        training is quiescent (the offline predict() case), but a fused
+        train step donates its slots buffer, so co-resident serving
+        must :meth:`swap` in an owned snapshot before the next tick
+        (the SnapshotPoller's first ``poll_once`` does exactly this)."""
+        if loss is None:
+            loss = getattr(getattr(store, "cfg", None), "loss", "logit")
+            loss = getattr(loss, "value", loss)   # Config enums carry .value
+        return cls(store.build_serve_margin(), store.serve_params(),
+                   loss=str(loss))
+
+    # -- the hot-swap surface ------------------------------------------------
+
+    @property
+    def params(self) -> Any:
+        with self._lock:
+            return self._params
+
+    def param_keys(self):
+        """Top-level param keys — the slice of a checkpoint state pytree
+        the swap consumes (state carries extras like the step clock)."""
+        with self._lock:
+            return tuple(self._params.keys())
+
+    def swap(self, params: Any) -> None:
+        """Atomically replace the served model. The forward reads the
+        params reference once per batch under the same lock, so a batch
+        sees either the old or the new model, never a mix; identical
+        avals are REQUIRED (a mismatch would retrace = recompile)."""
+        cur_leaves, cur_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(params)
+        if cur_def != new_def:
+            raise ValueError(
+                f"swap pytree mismatch: {new_def} vs served {cur_def}")
+        for i, (c, n) in enumerate(zip(cur_leaves, new_leaves)):
+            if _aval(c) != _aval(n):
+                raise ValueError(
+                    f"swap aval mismatch at leaf {i}: {_aval(n)} vs "
+                    f"served {_aval(c)} — a changed shape/dtype would "
+                    "silently recompile the serving forward")
+        with self._lock:
+            self._params = params
+
+    # -- inference -----------------------------------------------------------
+
+    def __call__(self, batch: SparseBatch):
+        """(margin, pred) device arrays for one padded batch."""
+        return self._fwd(self.params, batch)
+
+    def margins(self, batch: SparseBatch) -> jax.Array:
+        return self._fwd(self.params, batch)[0]
+
+    def predict(self, batch: SparseBatch) -> np.ndarray:
+        """Blocking host predictions for one padded batch."""
+        return np.asarray(self._fwd(self.params, batch)[1])
+
+
+def tile_margins(store, params: Any, block: dict, info) -> jax.Array:
+    """Margins for one crec2 tile block against caller-owned ``params``.
+
+    Rides the store's cached tile eval executable — the multi-channel
+    MXU pull of ``tile_train_step`` with no push — so a serving tier
+    co-resident with eval adds ZERO compilations; the (unused) metric
+    outputs cost a few reductions, far under the one-hot matmuls. The
+    margin is exact for every row, masked or not (labels only feed the
+    metric outputs).
+    """
+    step = store._tile_step(info, "eval")
+    if "mlp" in store.serve_params():
+        return step(params["slots"], params["mlp"], block)[5]
+    return step(params["slots"], block)[5]

@@ -1,0 +1,306 @@
+"""Admission batching: micro-requests -> fixed-shape device batches.
+
+XLA compiles per shape, so serving one request at a time would either
+recompile per nnz or waste a full batch on one row. The front-end
+aggregates concurrent micro-requests (one user's feature buckets each)
+into ONE padded :class:`~wormhole_tpu.data.feed.SparseBatch` of fixed
+geometry ``(serve_batch rows, serve_max_nnz nnz, key_pad uniq keys)``
+and flushes when the batch fills OR when the OLDEST admitted request
+has waited ``serve_deadline_ms`` — the classic latency/throughput
+admission trade, with the deadline bounding the tail.
+
+The flush is the ingest pipeline run in reverse: where training's
+DeviceFeed pulls a stream through localize/pad/transfer ahead of the
+consumer, the front-end pushes a request group through the SAME
+machinery (``DeviceFeed.prepare`` — localize via
+``localizer.localize_bucket_grid``, pad into the SparseBatch shape,
+``jax.device_put``) when admission fires, then runs the pull-only
+forward and fans results back to the waiting callers. Per-request
+latency (admission wait + flush + forward) feeds the ``serve/*``
+metrics through the obs registry; p50/p99 come from an exact reservoir
+of recent latencies (the registry histogram's fixed buckets are for
+export/merge, too coarse for a tail gate).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from wormhole_tpu.data.feed import SparseBatch, next_bucket
+from wormhole_tpu.data.localizer import localize_bucket_grid
+from wormhole_tpu.obs import trace
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("serve")
+
+__all__ = ["ServeFrontend", "ServeResult", "serve_metrics"]
+
+# exact-latency reservoir depth for the p50/p99 the bench gates on
+_LAT_WINDOW = 1 << 16
+
+
+def serve_metrics(reg):
+    """Single declaration site for the serve metric names (the
+    lint_knobs unique-name contract): (requests counter, queue-depth
+    gauge, latency histogram). Latency observes SECONDS so the default
+    registry buckets (1ms..100s) apply."""
+    return (reg.counter("serve/requests",
+                        help="micro-requests answered by the admission "
+                             "front-end"),
+            reg.gauge("serve/queue_depth",
+                      help="admission queue depth observed at flush "
+                           "time (max agg across flushes)", agg="max"),
+            reg.histogram("serve/latency_s",
+                          help="per-request serve latency in seconds "
+                               "(admission wait + batch build + "
+                               "forward)"))
+
+
+class ServeResult:
+    """Future for one submitted request; resolved at batch flush."""
+
+    __slots__ = ("keys", "vals", "t0", "_event", "margin", "pred", "_err")
+
+    def __init__(self, keys: np.ndarray, vals: np.ndarray,
+                 t0: float) -> None:
+        self.keys = keys
+        self.vals = vals
+        self.t0 = t0
+        self._event = threading.Event()
+        self.margin: Optional[float] = None
+        self.pred: Optional[float] = None
+        self._err: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None) -> float:
+        """Block until served; returns the prediction (sigmoid(margin)
+        for logit loss, raw margin otherwise)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request not answered in time")
+        if self._err is not None:
+            raise self._err
+        return self.pred
+
+    def _resolve(self, margin: float, pred: float) -> None:
+        self.margin = margin
+        self.pred = pred
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._err = exc
+        self._event.set()
+
+
+_CLOSE = object()
+
+
+class ServeFrontend:
+    """Thread-safe admission queue + flush loop around a ForwardStep.
+
+    Geometry is FIXED at construction (batch_rows x max_nnz, key_pad
+    unique keys) so every flush reuses one compiled forward — the
+    front-end's half of the zero-recompile contract. ``key_pad``
+    defaults to the worst case (every slot a distinct bucket), so a
+    flush can never overflow the unique-key vector.
+    """
+
+    def __init__(self, forward, *, batch_rows: int = 256,
+                 max_nnz: int = 64, key_pad: int = 0,
+                 deadline_ms: float = 5.0, registry=None,
+                 name: str = "serve") -> None:
+        from wormhole_tpu.data.pipeline import DeviceFeed
+        self.forward = forward
+        self.batch_rows = int(batch_rows)
+        self.max_nnz = int(max_nnz)
+        self.key_pad = int(key_pad) or next_bucket(
+            self.batch_rows * self.max_nnz, 64)
+        self.deadline_s = float(deadline_ms) / 1e3
+        self.name = name
+        # the ingest pad/transfer machinery, driven in reverse: prepare()
+        # runs prep (group -> padded SparseBatch) + device put with the
+        # stage stats/spans of a training feed, on the flush thread
+        self._feed = DeviceFeed((), prep=self._build_batch, workers=0,
+                                name=name)
+        self._q: "queue.Queue" = queue.Queue()
+        self._metrics = None
+        if registry is not None:
+            self._metrics = serve_metrics(registry)
+        self._lat: deque = deque(maxlen=_LAT_WINDOW)
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._batches = 0
+        self._deadline_flushes = 0
+        self._full_flushes = 0
+        self._depth_max = 0
+        self._trunc_warned = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"{name}-flush")
+        self._thread.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, keys: Sequence[int],
+               vals: Optional[Sequence[float]] = None) -> ServeResult:
+        """Enqueue one request (global bucket ids + optional values;
+        binary features default to 1.0). Returns a ServeResult future."""
+        if self._closed:
+            raise RuntimeError("serve frontend is closed")
+        keys = np.asarray(keys, np.int64).ravel()
+        if vals is None:
+            vals = np.ones(keys.shape, np.float32)
+        else:
+            vals = np.asarray(vals, np.float32).ravel()
+            if vals.shape != keys.shape:
+                raise ValueError(
+                    f"vals shape {vals.shape} != keys {keys.shape}")
+        req = ServeResult(keys, vals, time.monotonic())
+        self._q.put(req)
+        return req
+
+    def close(self) -> None:
+        """Stop admitting, flush everything pending, join the loop."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_CLOSE)
+        self._thread.join()
+
+    def stats(self) -> dict:
+        """Snapshot: request/batch counts, flush-cause split, queue
+        high-water mark, exact p50/p99 ms over the latency window."""
+        with self._lock:
+            lat = np.asarray(self._lat, np.float64)
+            out = {"requests": self._requests, "batches": self._batches,
+                   "deadline_flushes": self._deadline_flushes,
+                   "full_flushes": self._full_flushes,
+                   "queue_depth_max": self._depth_max}
+        if lat.size:
+            out["p50_ms"] = float(np.percentile(lat, 50) * 1e3)
+            out["p99_ms"] = float(np.percentile(lat, 99) * 1e3)
+        return out
+
+    # -- flush loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if first is _CLOSE:
+                break
+            group = [first]
+            closing = False
+            # admit until full OR the oldest request's deadline fires.
+            # The deadline bounds waiting for NEW arrivals only: under
+            # backlog (deadline already past at dequeue) the queue is
+            # drained non-blocking into full batches — flushing
+            # singletons there would collapse throughput exactly when
+            # batching matters most
+            deadline = first.t0 + self.deadline_s
+            while len(group) < self.batch_rows:
+                wait = deadline - time.monotonic()
+                try:
+                    nxt = (self._q.get_nowait() if wait <= 0
+                           else self._q.get(timeout=wait))
+                except queue.Empty:
+                    break
+                if nxt is _CLOSE:
+                    closing = True
+                    break
+                group.append(nxt)
+            self._flush(group)
+            if closing:
+                break
+        # drain whatever raced the close sentinel
+        tail = []
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is not _CLOSE:
+                tail.append(nxt)
+        for i in range(0, len(tail), self.batch_rows):
+            self._flush(tail[i:i + self.batch_rows])
+
+    def _flush(self, group) -> None:
+        depth = self._q.qsize()
+        full = len(group) >= self.batch_rows
+        try:
+            batch = self._feed.prepare(group)
+            with trace.span("serve:forward", cat="serve",
+                            args={"rows": len(group)}):
+                margin, pred = self.forward(batch)
+                margin = np.asarray(margin)
+                pred = np.asarray(pred)
+        except BaseException as exc:  # deliver, don't kill the loop
+            log.warning("serve flush failed: %s", exc)
+            for req in group:
+                req._fail(exc)
+            return
+        now = time.monotonic()
+        lats = []
+        for i, req in enumerate(group):
+            req._resolve(float(margin[i]), float(pred[i]))
+            lats.append(now - req.t0)
+        with self._lock:
+            self._lat.extend(lats)
+            self._requests += len(group)
+            self._batches += 1
+            self._full_flushes += int(full)
+            self._deadline_flushes += int(not full)
+            self._depth_max = max(self._depth_max, depth)
+        if self._metrics is not None:
+            req_c, depth_g, lat_h = self._metrics
+            req_c.inc(len(group))
+            depth_g.max(depth)
+            for v in lats:
+                lat_h.observe(v)
+
+    # -- batch assembly (DeviceFeed prep stage) ------------------------------
+
+    def _build_batch(self, group, _ctx=None) -> SparseBatch:
+        """Pad a request group into the fixed serve geometry: the
+        bucket-grid twin of ``feed.pad_to_batch`` (requests arrive
+        post-fold as global bucket ids, like the online tile spill
+        path), localized through the same ``localize_bucket_grid``."""
+        mb, nnz = self.batch_rows, self.max_nnz
+        grid = np.zeros((mb, nnz), np.int64)
+        valid = np.zeros((mb, nnz), bool)
+        vals = np.zeros((mb, nnz), np.float32)
+        for i, req in enumerate(group):
+            n = min(len(req.keys), nnz)
+            if n < len(req.keys) and not self._trunc_warned:
+                self._trunc_warned = True
+                log.warning(
+                    "request with %d features truncated to "
+                    "serve_max_nnz=%d (raise the knob to keep more)",
+                    len(req.keys), nnz)
+            grid[i, :n] = req.keys[:n]
+            valid[i, :n] = True
+            vals[i, :n] = req.vals[:n]
+        uniq, cols = localize_bucket_grid(grid, valid)
+        k = len(uniq)
+        if k > self.key_pad:     # unreachable with the default worst case
+            raise ValueError(
+                f"flush has {k} unique buckets but key_pad="
+                f"{self.key_pad}; raise serve key_pad")
+        uniq_p = np.zeros(self.key_pad, np.int32)
+        uniq_p[:k] = uniq.astype(np.int32)
+        key_mask = np.zeros(self.key_pad, np.float32)
+        key_mask[:k] = 1.0
+        row_mask = np.zeros(mb, np.float32)
+        row_mask[:len(group)] = 1.0
+        out = SparseBatch(cols=cols.astype(np.int32), vals=vals,
+                          labels=np.zeros(mb, np.float32),
+                          row_mask=row_mask, uniq_keys=uniq_p,
+                          key_mask=key_mask)
+        out.num_real = len(group)
+        return out

@@ -1,0 +1,173 @@
+"""Checkpoint hot-swap: serve fresh snapshots without dropping traffic.
+
+The reference's serving tier reads whatever the servers hold at pull
+time — online freshness for free, torn reads included (a pull can span
+a push). Here the serving tier owns its model, so freshness is an
+explicit loop: poll ``parallel/checkpoint`` for a version newer than
+the one being served, load it into a STANDBY pytree (the template's
+structure, host arrays), device-place it like the currently served
+params, and :meth:`~wormhole_tpu.serve.forward.ForwardStep.swap` the
+reference atomically between batches. Every batch therefore sees one
+consistent model version — strictly better than the reference's torn
+reads — at the cost of snapshot (not per-step) staleness, bounded by
+``checkpoint_every * poll interval``.
+
+The load/place work happens OFF the serving lock; only the final
+reference assignment synchronizes with the forward, so a swap never
+stalls traffic for the load. Avals are pinned by ``swap`` — a resized
+table in a new checkpoint fails loudly instead of silently retracing
+the serving forward.
+
+:class:`ServeRunner` is the single-chip co-residence harness: the
+caller's training loop runs on the main thread while the admission
+front-end and the poller thread serve between steps — the bench's
+"train co-resident" interference number comes from exactly this.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+from wormhole_tpu.obs import trace
+from wormhole_tpu.utils.logging import get_logger
+
+log = get_logger("serve")
+
+__all__ = ["SnapshotPoller", "ServeRunner"]
+
+
+class SnapshotPoller:
+    """Poll a Checkpointer for new versions and hot-swap a ForwardStep.
+
+    ``template_state`` is the host-side state pytree the checkpoints
+    were saved from (``store.state_pytree()`` shape) — the loader needs
+    its structure to place leaves. The served params are the subset of
+    top-level keys the forward declares (``param_keys()``); extras like
+    the step clock are ignored.
+    """
+
+    def __init__(self, ckpt, template_state: Any, forward, *,
+                 poll_itv: float = 2.0, start_version: int = 0) -> None:
+        self.ckpt = ckpt
+        self.template = template_state
+        self.forward = forward
+        self.poll_itv = float(poll_itv)
+        self.version = int(start_version)
+        self.swaps = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> bool:
+        """Check for a newer version; swap it in if found. Returns True
+        on a swap. Races with checkpoint GC (the version can vanish
+        between listing and reading) and half-written files surface as
+        OSError/KeyError/ValueError — logged and retried next poll, the
+        front-end keeps serving the current model."""
+        ver = self.ckpt.latest_version()
+        if ver <= self.version:
+            return False
+        try:
+            ver, state = self.ckpt.load(self.template, version=ver)
+        except (OSError, KeyError, ValueError) as exc:
+            log.warning("snapshot v%d load failed (%s); retrying "
+                        "next poll", ver, exc)
+            return False
+        cur = self.forward.params
+        fresh = {k: state[k] for k in self.forward.param_keys()}
+        # device-place the standby like the served params (sharded
+        # tables included) BEFORE taking the swap lock: traffic keeps
+        # flowing on the old model through the whole transfer
+        from wormhole_tpu.learners.store import put_like
+        fresh = jax.tree.map(put_like, cur, fresh)
+        with trace.span("serve:swap", cat="serve",
+                        args={"version": ver}):
+            self.forward.swap(fresh)
+        self.version = ver
+        self.swaps += 1
+        log.info("serving model v%d (swap #%d)", ver, self.swaps)
+        return True
+
+    # -- background thread ---------------------------------------------------
+
+    def start(self) -> "SnapshotPoller":
+        if self._thread is not None:
+            raise RuntimeError("poller already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-snapshot")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_itv):
+            try:
+                self.poll_once()
+            except Exception as exc:   # never kill serving over a poll
+                log.warning("snapshot poll failed: %s", exc)
+
+
+class ServeRunner:
+    """Co-schedule serving against a live training loop on one chip.
+
+    The front-end's flush thread and the poller run as daemons; the
+    caller's ``train_tick`` (one training step per call, or None for a
+    serve-only tier) runs on the thread that calls :meth:`run`. XLA
+    serializes the device work, so the interference between training
+    steps and serve forwards is real and measurable — ``bench.py
+    --phases serve`` reports it as the co-resident step rate vs. solo.
+    """
+
+    def __init__(self, frontend, poller: Optional[SnapshotPoller] = None,
+                 train_tick: Optional[Callable[[], Any]] = None) -> None:
+        self.frontend = frontend
+        self.poller = poller
+        self.train_tick = train_tick
+        self.train_steps = 0
+        self._closed = False
+        if self.poller is not None:
+            self.poller.start()
+
+    def run(self, seconds: Optional[float] = None,
+            steps: Optional[int] = None) -> int:
+        """Drive the training loop for a time/step budget (whichever
+        ends first) while serving continues; returns steps run this
+        call. With no ``train_tick`` it just sleeps out the budget
+        (serve-only tier keeping the process alive)."""
+        if seconds is None and steps is None:
+            raise ValueError("run() needs a seconds or steps budget")
+        t_end = None if seconds is None else time.monotonic() + seconds
+        n = 0
+        while ((steps is None or n < steps)
+               and (t_end is None or time.monotonic() < t_end)):
+            if self.train_tick is None:
+                time.sleep(min(0.05, max(t_end - time.monotonic(), 0))
+                           if t_end is not None else 0.05)
+                continue
+            self.train_tick()
+            n += 1
+            self.train_steps += 1
+        return n
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.poller is not None:
+            self.poller.stop()
+        self.frontend.close()
+
+    def __enter__(self) -> "ServeRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -174,6 +174,17 @@ class Config:
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1   # save a checkpoint every N data passes
+    # online serving (wormhole_tpu/serve): admission-batching front-end
+    # geometry + latency budget, snapshot hot-swap cadence, and offline
+    # predict routing. See docs/serving.md.
+    serve_batch: int = 256        # admission batch rows (device batch size)
+    serve_max_nnz: int = 64       # per-request feature cap (positional trunc)
+    serve_deadline_ms: float = 5.0  # flush when the oldest admitted request
+                                    # has waited this long (latency budget)
+    serve_poll_itv: float = 2.0   # snapshot poller interval, seconds
+    serve_predict: bool = True    # route offline predict() TEST margins
+                                  # through the pull-only serve forward
+                                  # (eval_step stays the metrics oracle)
 
     def merged(self, kvs: Sequence[str]) -> "Config":
         """Return a copy with ``key=value`` tokens merged over this config."""

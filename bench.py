@@ -1138,6 +1138,138 @@ def make_serve_train_batch(rng, nb: int):
                        key_mask=np.ones(k, np.float32))
 
 
+def bench_chaos() -> dict:
+    """Elastic recovery drill (wormhole_tpu/ft): SIGKILL one of 4 mp
+    ranks mid-epoch via the deterministic chaos injector, let the
+    supervised launcher detect the death, drain the survivors through a
+    block-boundary checkpoint, and relaunch — once shrunk to 3 ranks
+    (``--ft-elastic shrink``) and once at the original world
+    (``fixed``). Reported per scenario: wall time, relaunch count, the
+    per-attempt world read back from the attempt-scoped heartbeat dirs,
+    and the recovered final validation objv vs an undisturbed baseline
+    run (the recovery-quality number docs/fault_tolerance.md budgets;
+    tolerance rationale lives there too)."""
+    import re
+    import subprocess
+    import sys
+    import textwrap
+    from wormhole_tpu.obs import read_heartbeats
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="wh_bench_chaos_")
+    rng = np.random.default_rng(17)
+    dim = 64
+    for k in range(2):                       # 2 files x 400 planted rows
+        lines = []
+        for _ in range(400):
+            y = rng.random() < 0.5
+            feats = sorted(rng.choice(np.arange(2, dim), size=6,
+                                      replace=False))
+            toks = [f"{0 if y else 1}:1"] + [f"{j}:1" for j in feats]
+            lines.append(f"{int(y)} " + " ".join(toks))
+        with open(os.path.join(workdir, f"part{k}.libsvm"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+    pattern = os.path.join(workdir, "part*.libsvm")
+    cfg_common = ["data_format=libsvm", "num_buckets=4096",
+                  "minibatch=100", "max_nnz=16", "key_pad=256",
+                  "lr_eta=0.5", "max_delay=1", "disp_itv=1e12",
+                  f"train_data={pattern}", "num_parts_per_file=4",
+                  "max_data_pass=3"]
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+    def launch(name, extra_cfg, flags, timeout=420):
+        script = os.path.join(workdir, f"body_{name}.py")
+        with open(script, "w") as f:
+            f.write(textwrap.dedent(f"""
+                from wormhole_tpu.learners.async_sgd import AsyncSGD
+                from wormhole_tpu.utils.config import load_config
+                from wormhole_tpu.ft import supervisor as ft
+                cfg = load_config(None, {cfg_common + extra_cfg!r})
+                app = AsyncSGD(cfg)
+                app.run()
+                if not ft.drain_requested():
+                    pooled = []
+                    vp = app._multihost_pass(cfg.train_data, "val",
+                                             pooled)
+                    objv = vp.objv / max(vp.num_ex, 1)
+                    print(f"OK rank {{app.rt.rank}} objv={{objv:.6f}}")
+            """))
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "wormhole_tpu.parallel.launcher",
+             "-n", "4", "--cluster", "mp", *flags, "--",
+             sys.executable, script],
+            capture_output=True, text=True, timeout=timeout, cwd=repo,
+            env=env)
+        return r, time.perf_counter() - t0
+
+    def attempts_report(hb_dir) -> list:
+        """One row per launch attempt, from the attempt-scoped
+        heartbeat dirs (attempt 0 writes the base dir itself)."""
+        ks = [0]
+        if os.path.isdir(hb_dir):
+            ks += sorted(int(m.group(1)) for m in
+                         (re.match(r"^attempt(\d+)$", n)
+                          for n in os.listdir(hb_dir)) if m)
+        rows = []
+        for k in ks:
+            d = hb_dir if k == 0 else os.path.join(hb_dir, f"attempt{k}")
+            ranks = sorted(read_heartbeats(d)) if os.path.isdir(d) else []
+            if ranks or k == 0:
+                rows.append({"attempt": k, "world": len(ranks),
+                             "ranks": ranks})
+        return rows
+
+    def final_objv(stdout) -> float:
+        vals = re.findall(r"OK rank \d+ objv=([0-9.]+)", stdout)
+        if not vals:
+            raise RuntimeError("no final objv line in worker output")
+        return float(vals[-1])      # global metric: identical per rank
+
+    # -- undisturbed baseline ---------------------------------------------
+    r, base_wall = launch("baseline",
+                          [f"checkpoint_dir={workdir}/ckpt_base"], ())
+    if r.returncode != 0:
+        if "Multiprocess computations aren't" in r.stdout + r.stderr:
+            return {"skipped": "jax CPU backend lacks multiprocess "
+                               "collectives in this environment"}
+        raise RuntimeError(
+            f"baseline mp run failed rc={r.returncode}: "
+            f"{(r.stderr or r.stdout)[-800:]}")
+    base = final_objv(r.stdout)
+    out = {"world": 4, "kill": {"rank": 1, "block": 3},
+           "tol_rel": 0.25,
+           "baseline": {"objv": round(base, 6),
+                        "wall_s": round(base_wall, 1)}}
+
+    # -- kill drills: shrink and fixed relaunch ---------------------------
+    for mode in ("shrink", "fixed"):
+        if _deadline_passed():
+            out["budget_truncated"] = True
+            break
+        hb_dir = os.path.join(workdir, f"hb_{mode}")
+        r, wall = launch(
+            mode,
+            [f"checkpoint_dir={workdir}/ckpt_{mode}",
+             "chaos_kill_rank=1", "chaos_kill_block=3"],
+            ("--restarts", "2", "--ft-dead-after", "30",
+             "--ft-elastic", mode, "--comm-timeout", "8",
+             "--heartbeat-dir", hb_dir))
+        row = {"wall_s": round(wall, 1), "rc": r.returncode,
+               "relaunches": r.stderr.count("supervised relaunch"),
+               "attempts": attempts_report(hb_dir)}
+        if r.returncode == 0:
+            objv = final_objv(r.stdout)
+            row["objv"] = round(objv, 6)
+            row["objv_delta_rel"] = round(
+                abs(objv - base) / max(abs(base), 1e-9), 4)
+            row["within_tol"] = row["objv_delta_rel"] <= out["tol_rel"]
+        else:
+            row["error"] = (r.stderr or r.stdout)[-400:]
+        out[mode] = row
+    return out
+
+
 # ordered phase registry; headline phases first so a tight budget still
 # produces the metric. Phases needing the shared tile stores / the crec2
 # file / the text file are tagged so a filtered run only builds what it
@@ -1146,7 +1278,7 @@ PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "device_sparse", "device_dense_apply",
           "scale_curve", "serve", "comm_filters", "kmeans", "lbfgs",
-          "gbdt"]
+          "gbdt", "chaos"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1244,6 +1376,8 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
                 return {k: _round_serve(x) for k, x in v.items()}
             return round(v, 2) if isinstance(v, float) else v
         extra["serve"] = _round_serve(results["serve"])
+    if "chaos" in results:
+        extra["chaos_recovery"] = results["chaos"]
     if "comm_filters" in results:
         extra["comm_filters"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
@@ -1378,6 +1512,7 @@ def main(argv=None) -> None:
         "kmeans": bench_kmeans,
         "lbfgs": bench_lbfgs,
         "gbdt": bench_gbdt,
+        "chaos": bench_chaos,
     }
 
     results: dict = {}

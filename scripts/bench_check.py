@@ -85,7 +85,10 @@ def _keys_matching(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
     leaf name matches ``pat``. Paths (not bare leaf names) keep r02's
     ``e2e.ex_per_sec`` distinct from r03's
     ``e2e_steady_cached.ex_per_sec`` — different benchmarks, never
-    compared."""
+    compared. An ``attempts`` list (chaos phase: one entry per
+    supervised relaunch) contributes only its LAST entry, at the stable
+    path ``<p>.latest`` — earlier attempts end at an injected fault and
+    their count varies run to run, so comparing them would be noise."""
     found: Dict[str, float] = {}
 
     def walk(node, path: str) -> None:
@@ -93,7 +96,10 @@ def _keys_matching(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
             return
         for k, v in node.items():
             p = f"{path}.{k}" if path else k
-            if isinstance(v, dict):
+            if k == "attempts" and isinstance(v, list):
+                if v and isinstance(v[-1], dict):
+                    walk(v[-1], f"{p}.latest")
+            elif isinstance(v, dict):
                 walk(v, p)
             elif isinstance(v, (int, float)) and not isinstance(v, bool) \
                     and pat.search(k):
@@ -128,6 +134,10 @@ def ledger_fracs(parsed: dict) -> Dict[str, float]:
                     fv = v["frac"].get(name)
                     if isinstance(fv, (int, float)):
                         fracs[f"{p}.frac.{name}"] = float(fv)
+            elif k == "attempts" and isinstance(v, list):
+                # latest attempt only — same rule as _keys_matching
+                if v and isinstance(v[-1], dict):
+                    walk(v[-1], f"{p}.latest")
             elif isinstance(v, dict):
                 walk(v, p)
     walk(parsed, "")

@@ -186,3 +186,30 @@ def test_latency_improvement_never_fails(tmp_path):
     _write_run(d, 2, _parsed(100_000.0,
                              {"serve": {"solo": {"p99_ms": 5.0}}}))
     assert _run("--dir", d).returncode == 0
+
+
+def test_attempts_list_gates_latest_only(tmp_path):
+    """Chaos-phase ``attempts`` lists: only the LAST entry (the attempt
+    that completed) is compared, at a stable ``.latest`` path — earlier
+    attempts end at an injected fault and their count varies run to
+    run."""
+    d = str(tmp_path)
+
+    def chaos_extra(final_rate, n_attempts):
+        rows = [{"attempt": k, "ex_per_sec": 1.0}     # killed attempts
+                for k in range(n_attempts - 1)]
+        rows.append({"attempt": n_attempts - 1, "ex_per_sec": final_rate})
+        return {"chaos_recovery": {"shrink": {"attempts": rows}}}
+
+    # attempt counts differ (2 vs 3) and the killed attempts' garbage
+    # rates differ — neither may gate; equal final rates pass
+    _write_run(d, 1, _parsed(100_000.0, chaos_extra(5_000.0, 2)))
+    _write_run(d, 2, _parsed(100_000.0, chaos_extra(5_000.0, 3)))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a real drop in the completed attempt still fails, at .latest
+    _write_run(d, 2, _parsed(100_000.0, chaos_extra(1_000.0, 3)))
+    r = _run("--dir", d)
+    assert r.returncode == 1
+    assert "chaos_recovery.shrink.attempts.latest.ex_per_sec" \
+        in r.stderr, r.stderr

@@ -1,0 +1,119 @@
+"""Collective watchdog (wormhole_tpu/ft/watchdog.py): fires on an armed
+deadline left to expire, stays silent when the collective completes,
+and — the contract the hot path depends on — installs NOTHING when the
+knob is off."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from wormhole_tpu.ft import watchdog
+from wormhole_tpu.ft.watchdog import (COMM_TIMEOUT_ENV, PEER_LOST,
+                                      CollectiveWatchdog)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    watchdog.shutdown()
+    yield
+    watchdog.shutdown()
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_off_by_default_installs_nothing(monkeypatch):
+    monkeypatch.delenv(COMM_TIMEOUT_ENV, raising=False)
+    before = {t.name for t in threading.enumerate()}
+    assert watchdog.configure(0.0) is None
+    assert watchdog.get() is None
+    # the off-path guard is ONE shared no-op context, not a fresh object
+    assert watchdog.guard("a") is watchdog.guard("b")
+    assert "ft-watchdog" not in {t.name for t in threading.enumerate()}
+    assert {t.name for t in threading.enumerate()} == before
+
+
+def test_fires_on_silence():
+    fired = []
+    w = CollectiveWatchdog(0.05, exit_fn=fired.append)
+    try:
+        w.arm("async_sgd/status")
+        assert _wait_for(lambda: fired)
+        assert fired == ["async_sgd/status"]
+        assert w.fired_site == "async_sgd/status"
+    finally:
+        w.stop()
+
+
+def test_disarm_on_completion_never_fires():
+    fired = []
+    w = CollectiveWatchdog(0.08, exit_fn=fired.append)
+    try:
+        with w.armed("quick"):
+            pass
+        time.sleep(0.2)
+        assert not fired
+        assert w.fired_site is None
+    finally:
+        w.stop()
+
+
+def test_rearm_resets_deadline():
+    """Each collective gets the full timeout: repeated arms inside the
+    window must not accumulate into a spurious fire."""
+    fired = []
+    w = CollectiveWatchdog(0.15, exit_fn=fired.append)
+    try:
+        for site in ("a", "b", "c", "d"):
+            w.arm(site)
+            time.sleep(0.06)      # < timeout each, > timeout summed
+        w.disarm()
+        time.sleep(0.3)
+        assert not fired
+    finally:
+        w.stop()
+
+
+def test_configure_env_fallback(monkeypatch):
+    monkeypatch.setenv(COMM_TIMEOUT_ENV, "0.07")
+    w = watchdog.configure(0.0, exit_fn=lambda s: None)
+    assert w is not None
+    assert w.timeout_s == pytest.approx(0.07)
+    # explicit knob wins over env
+    w2 = watchdog.configure(1.5, exit_fn=lambda s: None)
+    assert w2.timeout_s == pytest.approx(1.5)
+
+
+def test_guard_arms_installed_watchdog():
+    fired = []
+    watchdog.configure(0.05, exit_fn=fired.append)
+    with watchdog.guard("blocked/site"):
+        assert _wait_for(lambda: fired)
+    assert fired == ["blocked/site"]
+
+
+def test_default_exit_is_peer_lost_117():
+    """Real exit path, in a subprocess: an armed watchdog left to expire
+    terminates the process with the distinguished PEER_LOST code."""
+    code = (
+        "import time\n"
+        "from wormhole_tpu.ft.watchdog import CollectiveWatchdog\n"
+        "CollectiveWatchdog(0.1).arm('dead/peer')\n"
+        "time.sleep(30)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60, cwd=repo)
+    assert r.returncode == PEER_LOST, (r.returncode, r.stderr)
+    assert "peer presumed lost" in r.stderr
+    assert "dead/peer" in r.stderr

@@ -1,0 +1,110 @@
+"""Supervised mp relaunch (wormhole_tpu/ft + launcher), exercised with
+plain-Python children so the detection → relaunch machinery is covered
+even where the jax CPU backend lacks multiprocess collectives (the full
+training drill lives in the slow test_ft_chaos_e2e.py)."""
+
+import os
+
+from test_launcher_mp import run_mp
+
+# child template: rank 1 SIGKILLs itself on attempt 0; everyone reports
+# the world/attempt they were launched with
+_CRASH_BODY = """
+    import os, signal, time
+    rank = int(os.environ["PROCESS_ID"])
+    attempt = int(os.environ["WORMHOLE_ATTEMPT"])
+    world = int(os.environ["NUM_PROCESSES"])
+    hb = os.environ.get("WORMHOLE_METRICS_EXPORT", "")
+    print(f"CHILD attempt={attempt} rank={rank} world={world} hb={hb}")
+    assert os.environ.get("WORMHOLE_FT_DRAIN") == "1"   # supervised runs drain
+    if attempt == 0 and rank == 1:
+        os.kill(os.getpid(), signal.SIGKILL)
+    time.sleep(0.5)
+"""
+
+
+def test_supervised_shrink_relaunch(tmp_path):
+    hb = tmp_path / "hb"
+    r = run_mp(3, _CRASH_BODY, raw=True,
+               launcher_args=("--restarts", "2", "--ft-dead-after", "30",
+                              "--ft-elastic", "shrink",
+                              "--heartbeat-dir", str(hb)))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rank(s) [1] lost" in r.stderr, r.stderr
+    assert "supervised relaunch 1/2 with world=2 (shrink)" in r.stderr
+    # the relaunched world is the survivors only…
+    assert "CHILD attempt=1 rank=0 world=2" in r.stdout
+    assert "CHILD attempt=1 rank=1 world=2" in r.stdout
+    assert "attempt=1 rank=2" not in r.stdout
+    # …and its telemetry is namespaced under attempt1/
+    assert "hb=" + os.path.join(str(hb), "attempt1") in r.stdout
+    assert (hb / "attempt1").is_dir()
+    # attempt 0 kept the base dir (unsupervised runs and the existing
+    # trace-merge contract depend on that)
+    assert f"attempt=0 rank=0 world=3 hb={hb}" in r.stdout
+
+
+def test_supervised_fixed_keeps_world(tmp_path):
+    r = run_mp(3, _CRASH_BODY, raw=True,
+               launcher_args=("--restarts", "1", "--ft-dead-after", "30",
+                              "--ft-elastic", "fixed",
+                              "--heartbeat-dir", str(tmp_path / "hb")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "supervised relaunch 1/1 with world=3 (fixed)" in r.stderr
+    for rank in range(3):
+        assert f"CHILD attempt=1 rank={rank} world=3" in r.stdout
+
+
+def test_supervised_restart_budget_exhausted(tmp_path):
+    # a job that dies on EVERY attempt: the supervisor gives up after
+    # `restarts` relaunches and surfaces the failing code
+    body = """
+        import os, signal
+        if int(os.environ["PROCESS_ID"]) == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        import time; time.sleep(0.5)
+    """
+    r = run_mp(2, body, raw=True,
+               launcher_args=("--restarts", "1", "--ft-dead-after", "30",
+                              "--heartbeat-dir", str(tmp_path / "hb")))
+    assert r.returncode != 0
+    assert r.stderr.count("supervised relaunch") == 1
+
+
+def test_supervised_kills_heartbeat_silent_rank(tmp_path):
+    """The hang path: a rank that stops heartbeating (but never exits)
+    is declared dead after ft_dead_after_s and SIGKILLed by the
+    launcher, which then relaunches the world."""
+    hb = tmp_path / "hb"
+    body = """
+        import json, os, time
+        rank = int(os.environ["PROCESS_ID"])
+        attempt = int(os.environ["WORMHOLE_ATTEMPT"])
+        d = os.environ["WORMHOLE_METRICS_EXPORT"]
+        os.makedirs(d, exist_ok=True)
+
+        def beat():
+            rec = {"ts": time.time(), "mono": time.monotonic(),
+                   "rank": rank, "seq": 0, "step": 1, "num_ex": 1,
+                   "ex_per_sec": 1.0}
+            with open(os.path.join(d, f"host{rank}.hb.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\\n")
+
+        beat()
+        if attempt == 0 and rank == 1:
+            time.sleep(120)          # wedged: beats once, then silence
+        for _ in range(12):          # healthy ranks keep beating
+            time.sleep(0.3)
+            beat()
+        print(f"DONE attempt={attempt} rank={rank}")
+    """
+    r = run_mp(2, body, raw=True, timeout=120,
+               launcher_args=("--restarts", "1", "--ft-dead-after", "2",
+                              "--ft-elastic", "fixed",
+                              "--heartbeat-dir", str(hb)))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "heartbeat-silent > 2s; declared dead, killing" in r.stderr
+    assert "rank(s) [1] lost" in r.stderr
+    assert "supervised relaunch 1/1 with world=2 (fixed)" in r.stderr
+    assert "DONE attempt=1 rank=0" in r.stdout
+    assert "DONE attempt=1 rank=1" in r.stdout

@@ -169,6 +169,58 @@ def test_merge_run_empty_dir_returns_none(tmp_path):
     assert merge.merge_run(str(tmp_path / "missing")) is None
 
 
+def test_latest_attempt_dir(tmp_path):
+    d = str(tmp_path)
+    # no attempt subdirs: the base dir is the answer (attempt 0 writes it)
+    assert merge.latest_attempt_dir(d) == d
+    assert merge.latest_attempt_dir("") == ""
+    assert merge.latest_attempt_dir(str(tmp_path / "nope")) == \
+        str(tmp_path / "nope")
+    os.makedirs(tmp_path / "attempt1")
+    os.makedirs(tmp_path / "attempt2")
+    os.makedirs(tmp_path / "attempt10")          # numeric, not lexical
+    (tmp_path / "attempt99").write_text("file, not a dir")
+    assert merge.latest_attempt_dir(d) == str(tmp_path / "attempt10")
+
+
+def test_merge_run_resolves_latest_attempt(tmp_path):
+    """A supervised relaunch namespaces telemetry per attempt; the
+    exit-time merge must read the newest attempt, not the base dir of
+    the attempt-0 run that died."""
+    base = str(tmp_path)
+    # attempt 0 (base dir): a stale 2-rank run
+    for rank in (0, 1):
+        doc = _rank_doc(rank, 100.0,
+                        [_coll_ev("stale/site", 0, 10_000, 1_000)])
+        name = "trace.json" if rank == 0 else f"trace.r{rank}.json"
+        with open(os.path.join(base, name), "w") as f:
+            json.dump(doc, f)
+    # attempt 1: the run that completed, one rank fewer (shrink)
+    att = tmp_path / "attempt1"
+    os.makedirs(att)
+    with open(att / "trace.json", "w") as f:
+        json.dump(_rank_doc(0, 100.0,
+                            [_coll_ev("fresh/site", 0, 10_000, 1_000)]),
+                  f)
+    hb_dir = tmp_path / "hb"
+    hb_att = hb_dir / "attempt1"
+    os.makedirs(hb_att)
+    with open(heartbeat_path(str(hb_att), 0), "w") as f:
+        for rec in _hb(0, 100.0, 0.0):
+            f.write(json.dumps(rec) + "\n")
+
+    res = merge.merge_run(base, str(hb_dir))
+    assert res is not None
+    merged_path, report = res
+    assert os.path.dirname(merged_path) == str(att)
+    assert report["ranks"] == [0]
+    assert report["clock_source"] == "heartbeat"    # attempt-scoped hb dir
+    sites = {e["args"]["site"]
+             for e in json.load(open(merged_path))["traceEvents"]
+             if e.get("args")}
+    assert sites == {"fresh/site"}
+
+
 # -- collective (site, seq) stamping -----------------------------------------
 
 def test_collective_spans_carry_site_seq():

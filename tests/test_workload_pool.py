@@ -51,6 +51,50 @@ def test_failure_requeue(tmp_path):
     assert pool.is_finished()
 
 
+def test_reset_requeues_in_flight_at_head(tmp_path):
+    """Node-failure re-queue (the ft relaunch path rebuilds on this):
+    every part the dead worker held goes back to the HEAD of the queue,
+    ahead of never-started work, so recovery re-runs lost work first."""
+    pool = WorkloadPool()
+    pool.add(make_files(tmp_path, 4), npart=1)
+    a = pool.get("dead")
+    b = pool.get("dead")
+    c = pool.get("alive")
+    assert a and b and c
+    pool.reset("dead")
+    assert pool.pending() == 4                 # 2 re-queued + 1 held + 1
+    # the dead worker's parts come back before the untouched 4th part
+    ids = [pool.get("recovery").id for _ in range(3)]
+    assert set(ids[:2]) == {a.id, b.id}
+    pool.reset("ghost")                        # unknown worker: no-op
+    for wid in ids + [c.id]:
+        pool.finish(wid)
+    assert pool.is_finished()
+
+
+def test_reset_spares_part_with_live_straggler_copy(tmp_path):
+    """reset() of one holder must NOT re-queue a part whose straggler
+    copy is still running on another worker — and the survivor's death
+    afterwards must still re-queue it (no part ever lost)."""
+    clock = [0.0]
+    pool = WorkloadPool(straggler_factor=3.0, time_fn=lambda: clock[0])
+    pool.add(make_files(tmp_path, 2), npart=1)
+    quick = pool.get("w0")
+    clock[0] += 1.0
+    pool.finish(quick.id)                      # 1s mean established
+    slow = pool.get("w0")
+    clock[0] += 50.0                           # way past 3x mean
+    copy = pool.get("w1")                      # straggler re-issue
+    assert copy.id == slow.id
+    pool.reset("w0")                           # original holder dies
+    assert pool.get("w2") is None              # w1's copy still runs it
+    pool.reset("w1")                           # the copy's holder dies too
+    wl = pool.get("w2")                        # now it must come back
+    assert wl is not None and wl.id == slow.id
+    pool.finish(wl.id)
+    assert pool.is_finished()
+
+
 def test_straggler_reexecution(tmp_path):
     clock = [0.0]
     pool = WorkloadPool(straggler_factor=3.0, time_fn=lambda: clock[0])

@@ -1,0 +1,38 @@
+"""Elastic recovery subsystem: watchdog, supervisor, chaos injection.
+
+The reference's reliability story is rabit checkpoint-recovery plus a
+dynamic workload pool that re-queues a failed worker's shards
+(workload_pool.h:111,125-140) behind a tracker that relaunches dead
+nodes. The TPU rebuild already owns every ingredient — versioned
+checkpoints (parallel/checkpoint.py), the replicated WorkloadPool
+(sched/workload_pool.py), heartbeat files (obs/heartbeat.py), and
+``launch_mp --restarts`` — but JAX's multi-controller runtime adds the
+missing failure mode: a SIGKILLed rank leaves every survivor blocked
+forever inside a host collective (a lost process cannot rejoin a live
+mesh). This package closes the loop:
+
+- :mod:`.watchdog` — a ``comm_timeout_s`` deadline armed around every
+  blocking host collective; a survivor stuck on a dead peer exits with
+  the distinguished ``PEER_LOST`` code instead of hanging.
+- :mod:`.supervisor` — launcher-side dead-rank declaration from
+  heartbeat silence plus the learner-side SIGTERM drain protocol
+  (stop at a block boundary, commit a checkpoint, exit cleanly), and
+  the shrink/fixed relaunch policy.
+- :mod:`.chaos` — deterministic fault injection (kill rank r at block
+  k, heartbeat/collective delay, transient checkpoint-IO errors) that
+  the chaos e2e test and ``bench.py --phases chaos`` drive through
+  ordinary config knobs.
+
+Everything here is stdlib-only at module level (the collectives and the
+heartbeat writer import it on their hot paths) and off by default: with
+no knob set there is no watchdog thread, no signal handler, and no
+chaos plan — just one ``is None`` check per hook site.
+
+See docs/fault_tolerance.md for the detection → drain → relaunch state
+machine and the shrink-vs-fixed tradeoff.
+"""
+
+from . import chaos, watchdog
+from .watchdog import PEER_LOST
+
+__all__ = ["chaos", "watchdog", "PEER_LOST"]

@@ -1,0 +1,150 @@
+"""Deterministic fault injection for the recovery subsystem.
+
+A chaos *plan* is installed once per process from ordinary config knobs
+(``chaos_*`` in utils/config.py) or the ``WORMHOLE_CHAOS`` env var
+(``k=v,k=v`` with the same names minus the prefix). The hooks below are
+called from the hot paths they disturb:
+
+- :func:`tick_block` — ``ReplicatedRounds.produced``: SIGKILL
+  ``kill_rank`` once its cumulative produced-block count reaches
+  ``kill_block`` (mid-epoch rank death).
+- :func:`on_collective` — the host collectives: sleep
+  ``collective_delay_s`` on ``delay_rank`` (a slow/partitioned peer;
+  with a short ``comm_timeout_s`` this drives the watchdog).
+- :func:`on_heartbeat` — ``HeartbeatWriter.beat``: sleep
+  ``heartbeat_delay_s`` on ``delay_rank`` (a stalled heartbeat, fodder
+  for the supervisor's dead-after detection).
+- :func:`ckpt_fault` — the checkpoint commit helper: raise ``OSError``
+  for the first ``ckpt_errors`` commits (transient IO blip; the commit
+  path retries once).
+
+Faults fire only on attempt 0 (``WORMHOLE_ATTEMPT``, exported by the
+launcher on every launch): the injection run takes the fault, the
+supervised relaunch must come up clean. With no knob set ``install``
+leaves the plan ``None`` and every hook is a single global check.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict, Optional
+
+ATTEMPT_ENV = "WORMHOLE_ATTEMPT"
+CHAOS_ENV = "WORMHOLE_CHAOS"
+
+_DEFAULTS: Dict[str, Any] = {
+    "kill_rank": -1,
+    "kill_block": 0,
+    "delay_rank": -1,
+    "collective_delay_s": 0.0,
+    "heartbeat_delay_s": 0.0,
+    "ckpt_errors": 0,
+}
+
+_PLAN: Optional[Dict[str, Any]] = None
+_RANK = -1
+_BLOCKS = 0
+_CKPT_FAULTS = 0
+
+
+def current_attempt() -> int:
+    """Relaunch attempt of this process (0 = first launch)."""
+    try:
+        return int(os.environ.get(ATTEMPT_ENV, "0") or "0")
+    except ValueError:
+        return 0
+
+
+def _env_plan() -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    raw = os.environ.get(CHAOS_ENV, "")
+    for item in raw.split(","):
+        if "=" not in item:
+            continue
+        k, v = item.split("=", 1)
+        k = k.strip()
+        if k in _DEFAULTS:
+            out[k] = type(_DEFAULTS[k])(float(v))
+    return out
+
+
+def install(plan: Dict[str, Any], rank: int) -> bool:
+    """Install a chaos plan for this process; returns True when armed.
+
+    Inert plans (all defaults), non-zero attempts, and unknown keys all
+    resolve to "no plan": the hooks then cost one global load.
+    """
+    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS
+    merged = dict(_DEFAULTS)
+    merged.update(_env_plan())
+    merged.update({k: v for k, v in plan.items() if k in _DEFAULTS})
+    armed = (merged != _DEFAULTS) and current_attempt() == 0
+    _PLAN = merged if armed else None
+    _RANK = int(rank)
+    _BLOCKS = 0
+    _CKPT_FAULTS = 0
+    return armed
+
+
+def install_from_config(cfg: Any, rank: int) -> bool:
+    return install({
+        "kill_rank": getattr(cfg, "chaos_kill_rank", -1),
+        "kill_block": getattr(cfg, "chaos_kill_block", 0),
+        "delay_rank": getattr(cfg, "chaos_delay_rank", -1),
+        "collective_delay_s": getattr(cfg, "chaos_collective_delay_s", 0.0),
+        "heartbeat_delay_s": getattr(cfg, "chaos_heartbeat_delay_s", 0.0),
+        "ckpt_errors": getattr(cfg, "chaos_ckpt_errors", 0),
+    }, rank)
+
+
+def reset() -> None:
+    """Drop any installed plan (test teardown)."""
+    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS
+    _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS = None, -1, 0, 0
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def tick_block(n: int = 1) -> None:
+    """Count produced blocks; SIGKILL self at the planted block index."""
+    global _BLOCKS
+    p = _PLAN
+    if p is None:
+        return
+    _BLOCKS += int(n)
+    if p["kill_rank"] == _RANK and _BLOCKS > p["kill_block"] >= 0:
+        sys.stderr.write(
+            f"[ft] chaos: SIGKILL rank {_RANK} at block {p['kill_block']}\n")
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_collective(site: Optional[str] = None) -> None:
+    p = _PLAN
+    if p is not None and p["collective_delay_s"] > 0 \
+            and p["delay_rank"] == _RANK:
+        time.sleep(p["collective_delay_s"])
+
+
+def on_heartbeat() -> None:
+    p = _PLAN
+    if p is not None and p["heartbeat_delay_s"] > 0 \
+            and p["delay_rank"] == _RANK:
+        time.sleep(p["heartbeat_delay_s"])
+
+
+def ckpt_fault(path: str) -> None:
+    """Raise a transient OSError for the first ``ckpt_errors`` commits."""
+    global _CKPT_FAULTS
+    p = _PLAN
+    if p is None or _CKPT_FAULTS >= p["ckpt_errors"]:
+        return
+    _CKPT_FAULTS += 1
+    raise OSError(
+        f"chaos: injected transient checkpoint IO error "
+        f"#{_CKPT_FAULTS} ({path})")

@@ -1,0 +1,166 @@
+"""Collective watchdog: turn a hang on a dead peer into a clean exit.
+
+JAX multi-controller collectives (``process_allgather``,
+``sync_global_devices``, and everything built on them) block inside C
+until *every* process arrives. When a peer is SIGKILLed mid-step the
+survivors wait forever — Python signal handlers cannot run while the
+interpreter is parked in a C call, so even SIGTERM cannot drain them.
+The watchdog is the escape hatch: a single daemon thread holds one
+armed deadline; each blocking collective arms it on entry and disarms
+on return. If the deadline passes while still armed, the thread prints
+one diagnostic line and ``os._exit``\\ s the process with the
+distinguished :data:`PEER_LOST` code, which the supervised launcher
+treats as "bystander of someone else's failure", not a crash.
+
+Off by default: ``configure(0)`` (the default knob) installs nothing —
+no thread exists and :func:`guard` returns one shared no-op context, so
+the per-collective cost is a function call and a global load.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+# Exit code of a survivor that abandoned a collective because a peer was
+# presumed lost. Chosen outside the bash/errno conventions (and far from
+# signal-death codes, which the launcher sees as negative waitpid codes).
+PEER_LOST = 117
+
+# Env fallback for processes that never build a Config (exported by the
+# supervised launcher so every child inherits the timeout).
+COMM_TIMEOUT_ENV = "WORMHOLE_COMM_TIMEOUT_S"
+
+
+class CollectiveWatchdog:
+    """One monitor thread, armed/disarmed around blocking collectives.
+
+    Arm/disarm is generation-counted so a stale wakeup of the monitor
+    thread (scheduled before a disarm, delivered after a re-arm) can
+    never fire against the wrong collective.
+    """
+
+    def __init__(self, timeout_s: float,
+                 exit_fn: Optional[Callable[[str], None]] = None) -> None:
+        self.timeout_s = float(timeout_s)
+        self._exit = exit_fn if exit_fn is not None else self._default_exit
+        self._cv = threading.Condition()
+        self._gen = 0
+        self._armed_gen: Optional[int] = None
+        self._site = ""
+        self._deadline = 0.0
+        self._stopped = False
+        self.fired_site: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ft-watchdog")
+        self._thread.start()
+
+    def _default_exit(self, site: str) -> None:
+        sys.stderr.write(
+            f"[ft] watchdog: collective {site!r} blocked > "
+            f"{self.timeout_s:.1f}s — peer presumed lost; "
+            f"exiting with PEER_LOST ({PEER_LOST})\n")
+        sys.stderr.flush()
+        os._exit(PEER_LOST)
+
+    def arm(self, site: str) -> None:
+        with self._cv:
+            self._gen += 1
+            self._armed_gen = self._gen
+            self._site = str(site)
+            self._deadline = time.monotonic() + self.timeout_s
+            self._cv.notify()
+
+    def disarm(self) -> None:
+        with self._cv:
+            self._armed_gen = None
+            self._cv.notify()
+
+    @contextlib.contextmanager
+    def armed(self, site: str):
+        self.arm(site)
+        try:
+            yield
+        finally:
+            self.disarm()
+
+    def stop(self) -> None:
+        """Shut the monitor thread down (tests; production exits instead)."""
+        with self._cv:
+            self._stopped = True
+            self._armed_gen = None
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        with self._cv:
+            while not self._stopped:
+                if self._armed_gen is None:
+                    self._cv.wait()
+                    continue
+                gen = self._armed_gen
+                remaining = self._deadline - time.monotonic()
+                if remaining > 0:
+                    self._cv.wait(timeout=remaining)
+                    continue
+                if self._armed_gen != gen:
+                    continue  # stale wakeup: disarmed/re-armed meanwhile
+                site = self._site
+                self._armed_gen = None
+                self.fired_site = site
+                # exit_fn normally never returns (os._exit); tests inject
+                # a recorder, in which case keep monitoring
+                self._exit(site)
+
+
+_WATCHDOG: Optional[CollectiveWatchdog] = None
+# shared no-op context handed out when no watchdog is installed —
+# nullcontext is reentrant, so one instance serves every call site
+_OFF = contextlib.nullcontext()
+
+
+def configure(timeout_s: float = 0.0,
+              exit_fn: Optional[Callable[[str], None]] = None,
+              ) -> Optional[CollectiveWatchdog]:
+    """Install (effective timeout > 0) or remove (== 0) the watchdog.
+
+    A zero ``timeout_s`` falls back to the :data:`COMM_TIMEOUT_ENV`
+    env var (the supervised launcher's export); zero both ways means
+    no watchdog at all. Re-configuring stops any previous instance.
+    """
+    global _WATCHDOG
+    eff = float(timeout_s)
+    if eff <= 0:
+        try:
+            eff = float(os.environ.get(COMM_TIMEOUT_ENV, "0") or "0")
+        except ValueError:
+            eff = 0.0
+    if _WATCHDOG is not None:
+        _WATCHDOG.stop()
+        _WATCHDOG = None
+    if eff > 0:
+        _WATCHDOG = CollectiveWatchdog(eff, exit_fn=exit_fn)
+    return _WATCHDOG
+
+
+def shutdown() -> None:
+    """Remove the watchdog regardless of env (test teardown)."""
+    global _WATCHDOG
+    if _WATCHDOG is not None:
+        _WATCHDOG.stop()
+        _WATCHDOG = None
+
+
+def get() -> Optional[CollectiveWatchdog]:
+    return _WATCHDOG
+
+
+def guard(site: str):
+    """Context manager arming the watchdog around one blocking collective;
+    the shared no-op when none is installed."""
+    w = _WATCHDOG
+    return w.armed(site) if w is not None else _OFF

@@ -31,6 +31,9 @@ import numpy as np
 
 from wormhole_tpu import obs
 from wormhole_tpu.data.feed import next_bucket, nnz_bucket, pad_to_batch
+from wormhole_tpu.ft import chaos as ft_chaos
+from wormhole_tpu.ft import supervisor as ft_supervisor
+from wormhole_tpu.ft import watchdog as ft_watchdog
 from wormhole_tpu.data.localizer import Localizer
 from wormhole_tpu.data.minibatch import MinibatchIter
 from wormhole_tpu.learners.handles import LearnRate, create_handle
@@ -137,6 +140,14 @@ class AsyncSGD:
         # pooled AUC, model broadcast — rides the same chain
         from wormhole_tpu.parallel import filters as comm_filters
         comm_filters.install_from_config(cfg)
+        # fault-tolerance wiring (wormhole_tpu/ft): the collective
+        # watchdog turns a hang on a dead peer into a PEER_LOST exit,
+        # chaos installs the deterministic fault plan, and the drain
+        # handler (active only under a supervised launcher) turns
+        # SIGTERM into a block-boundary checkpoint + clean exit
+        ft_watchdog.configure(cfg.comm_timeout_s)
+        ft_chaos.install_from_config(cfg, self.rt.rank)
+        ft_supervisor.install_drain_handler()
 
     # -- worker data path ---------------------------------------------------
 
@@ -968,12 +979,18 @@ class AsyncSGD:
         prev_objv_ex = None
         last_saved = start_pass
         completed = start_pass
+        drained = False
         for data_pass in range(start_pass, cfg.max_data_pass):
             self.pool.clear()
             self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
             wd_before = self.progress.wdelta2
             pass_prog = Progress()
             while True:
+                if ft_supervisor.drain_requested():
+                    # supervised SIGTERM: stop at this part boundary,
+                    # commit below, exit cleanly (docs/fault_tolerance.md)
+                    drained = True
+                    break
                 wl = self.pool.get(worker)
                 if wl is None:
                     break
@@ -982,6 +999,11 @@ class AsyncSGD:
                 pass_prog.merge(prog)
                 self.pool.finish(wl.id)
                 self._check_divergence(prog)
+            if drained:
+                self.progress.merge(self.flush_metrics())
+                log.info("drain requested: abandoning pass %d at a part "
+                         "boundary (completed=%d)", data_pass, completed)
+                break
             tail = self.flush_metrics()
             self.progress.merge(tail)
             pass_prog.merge(tail)
@@ -1006,13 +1028,15 @@ class AsyncSGD:
             if self._converged(data_pass, pass_prog, prev_objv_ex):
                 break
             prev_objv_ex = pass_prog.objv / max(pass_prog.num_ex, 1)
-        if cfg.checkpoint_dir and self._ckpt_ok() and last_saved < completed:
+        if cfg.checkpoint_dir and self._ckpt_ok() and \
+                (last_saved < completed or (drained and completed)):
             # the final pass must never be lost to checkpoint_every
-            # misalignment or an epsilon early stop
+            # misalignment or an epsilon early stop; a drain re-commits
+            # `completed` with the freshest (mid-pass) state
             self.ckpt.save(completed, self.store.state_pytree())
-        if cfg.test_data:
+        if cfg.test_data and not drained:
             self.predict(cfg.test_data, cfg.pred_out)
-        if cfg.model_out:
+        if cfg.model_out and not drained:
             self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
@@ -1156,6 +1180,11 @@ class AsyncSGD:
                 self._display(local)
 
         while True:
+            if ft_supervisor.drain_requested():
+                # supervised SIGTERM: a peer is dead or dying — leave
+                # the round loop BEFORE the next collective (which could
+                # block on the dead rank) and let run_multihost commit
+                raise ft_supervisor.DrainInterrupt()
             blk = None
             if my_it is not None:
                 with self.timer.scope(pfx + "parse"):
@@ -1355,6 +1384,8 @@ class AsyncSGD:
         from wormhole_tpu.parallel.collectives import (
             allgather_tree, allreduce_tree, host_local_to_global)
         while True:
+            if ft_supervisor.drain_requested():
+                raise ft_supervisor.DrainInterrupt()
             group: list = []
             collect(group)
             # drained hosts stay needy: a straggler re-issue must find a
@@ -1501,47 +1532,67 @@ class AsyncSGD:
         prev_objv_ex = None
         last_saved = start_pass
         completed = start_pass
-        for data_pass in range(start_pass, cfg.max_data_pass):
-            prog = (self._multihost_pass_crec(cfg.train_data, TRAIN)
-                    if crec
-                    else self._multihost_pass(cfg.train_data, TRAIN))
-            self.progress.merge(prog)
-            self._check_divergence(prog)
-            completed = data_pass + 1
-            if ckpt is not None \
-                    and completed % max(cfg.checkpoint_every, 1) == 0:
+        drained = False
+        try:
+            for data_pass in range(start_pass, cfg.max_data_pass):
+                prog = (self._multihost_pass_crec(cfg.train_data, TRAIN)
+                        if crec
+                        else self._multihost_pass(cfg.train_data, TRAIN))
+                self.progress.merge(prog)
+                self._check_divergence(prog)
+                completed = data_pass + 1
+                if ckpt is not None \
+                        and completed % max(cfg.checkpoint_every, 1) == 0:
+                    self.ckpt_version = completed
+                    ckpt.save(completed, self.store.state_pytree())
+                    last_saved = completed
+                if cfg.val_data:
+                    pooled: list = []
+                    vp = (self._multihost_pass_crec(cfg.val_data, VAL,
+                                                    pooled)
+                          if crec
+                          else self._multihost_pass(cfg.val_data, VAL,
+                                                    pooled))
+                    pass_auc = self._allreduce_pooled_auc(pooled)
+                    n = max(vp.num_ex, 1)
+                    log.info("pass %d validation: objv=%.6f auc=%.6f "
+                             "acc=%.6f", data_pass, vp.objv / n, pass_auc,
+                             vp.acc / max(vp.count, 1))
+                # prog is GLOBAL (identical on all ranks), so every rank
+                # takes the early-stop branch in the same pass
+                if self._converged(data_pass, prog, prev_objv_ex):
+                    break
+                prev_objv_ex = prog.objv / max(prog.num_ex, 1)
+        except ft_supervisor.DrainInterrupt:
+            # supervised SIGTERM (a peer is dead): commit a survivor
+            # checkpoint WITHOUT the cross-rank barrier — peers may be
+            # gone, and the resume-version allreduce-min is the real
+            # agreement (a version only wins when all relaunched ranks
+            # hold it). Version `completed` is re-committed with the
+            # freshest block-boundary state; its marker already exists,
+            # so an interrupted drain leaves the old commit intact.
+            drained = True
+            log.info("drain requested: abandoning pass at a block "
+                     "boundary; committing survivor checkpoint v%d",
+                     completed)
+            if ckpt is not None and completed:
                 self.ckpt_version = completed
-                ckpt.save(completed, self.store.state_pytree())
+                ckpt.save(completed, self.store.state_pytree(),
+                          barrier=False)
                 last_saved = completed
-            if cfg.val_data:
-                pooled: list = []
-                vp = (self._multihost_pass_crec(cfg.val_data, VAL,
-                                                pooled)
-                      if crec
-                      else self._multihost_pass(cfg.val_data, VAL, pooled))
-                pass_auc = self._allreduce_pooled_auc(pooled)
-                n = max(vp.num_ex, 1)
-                log.info("pass %d validation: objv=%.6f auc=%.6f "
-                         "acc=%.6f", data_pass, vp.objv / n, pass_auc,
-                         vp.acc / max(vp.count, 1))
-            # prog is GLOBAL (identical on all ranks), so every rank
-            # takes the early-stop branch in the same pass
-            if self._converged(data_pass, prog, prev_objv_ex):
-                break
-            prev_objv_ex = prog.objv / max(prog.num_ex, 1)
         if ckpt is not None and last_saved < completed:
             # the final pass must never be lost to checkpoint_every
             # misalignment or an epsilon early stop
             self.ckpt_version = completed
             ckpt.save(completed, self.store.state_pytree())
-        if cfg.test_data:
+        if cfg.test_data and not drained:
             pooled = []
             if crec:
                 self._multihost_pass_crec(cfg.test_data, TEST, pooled)
             else:
                 self._multihost_pass(cfg.test_data, TEST, pooled)
             self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
-        if cfg.model_out:
+        if cfg.model_out and not drained:
             self._store_io("save", cfg.model_out)
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())

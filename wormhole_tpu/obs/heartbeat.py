@@ -77,12 +77,28 @@ class HeartbeatWriter:
         rec.update(extra)
         if self.registry is not None:
             rec = self.registry.record(**rec)
+        from wormhole_tpu.ft import chaos
+        chaos.on_heartbeat()
         try:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
-        except OSError:
-            # monitoring must never kill training; stop retrying
+        except OSError as e:
+            # monitoring must never kill training; stop retrying — but
+            # not silently: monitor-side "silence" from this rank now
+            # means a lost heartbeat FILE, not a dead rank, and the
+            # warning + counter are how the two are told apart
             self._dead = True
+            import logging
+            logging.getLogger("wormhole.obs").warning(
+                "heartbeat write to %s failed (%s); rank %d stops "
+                "heartbeating — treat monitor-side silence accordingly",
+                self.path, e, self.rank)
+            if self.registry is not None:
+                self.registry.counter(
+                    "heartbeat/write_errors",
+                    help="heartbeat appends that failed; the writer goes "
+                         "silent after the first, so nonzero explains "
+                         "monitor-side heartbeat silence").inc()
             return False
         self._last = now
         self._prev_ex = num_ex

@@ -30,15 +30,36 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Dict, List, Optional, Tuple
 
 from .heartbeat import read_heartbeats
 
 __all__ = ["load_rank_traces", "clock_offsets", "merge_traces",
-           "merge_run"]
+           "merge_run", "latest_attempt_dir"]
 
 MERGED_TRACE = "merged.trace.json"
 SKEW_REPORT = "skew_report.json"
+
+_ATTEMPT_DIR = re.compile(r"^attempt(\d+)$")
+
+
+def latest_attempt_dir(directory: str) -> str:
+    """Resolve a telemetry dir to its newest ``attempt<k>/`` subdir.
+
+    A supervised relaunch namespaces each attempt's heartbeat/trace
+    files under ``attempt<k>/`` (attempt 0 writes the base dir itself),
+    so merging the base dir of a relaunched run would mix attempts.
+    Returns ``directory`` unchanged when no attempt subdir exists."""
+    if not directory or not os.path.isdir(directory):
+        return directory
+    best, best_k = directory, -1
+    for name in os.listdir(directory):
+        m = _ATTEMPT_DIR.match(name)
+        if m and int(m.group(1)) > best_k \
+                and os.path.isdir(os.path.join(directory, name)):
+            best, best_k = os.path.join(directory, name), int(m.group(1))
+    return best
 
 
 def load_rank_traces(trace_dir: str) -> Dict[int, dict]:
@@ -203,7 +224,11 @@ def merge_run(trace_dir: str, heartbeat_dir: str = "",
     """Gather every rank trace under ``trace_dir``, merge, and write
     ``merged.trace.json`` + ``skew_report.json`` (or the given paths).
     Returns ``(merged_trace_path, report)``, or None when no rank trace
-    exists — the launcher calls this unconditionally at exit."""
+    exists — the launcher calls this unconditionally at exit. Both dirs
+    resolve to their newest ``attempt<k>/`` subdir when a supervised
+    relaunch namespaced them (:func:`latest_attempt_dir`)."""
+    trace_dir = latest_attempt_dir(trace_dir)
+    heartbeat_dir = latest_attempt_dir(heartbeat_dir)
     docs = load_rank_traces(trace_dir)
     if not docs:
         return None

@@ -23,12 +23,41 @@ from typing import Any, Optional, Tuple
 
 from flax import serialization
 
+from wormhole_tpu.ft import chaos as _chaos
+from wormhole_tpu.ft import watchdog as _watchdog
 from wormhole_tpu.obs import trace
 from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("checkpoint")
 
 _FNAME = re.compile(r"^ckpt_v(\d+)\.msgpack$")
+
+
+def _commit_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` durably and atomically.
+
+    fsync before the rename: os.replace alone makes the *name* atomic
+    but not the *bytes* durable — after a power cut the new name can
+    point at a truncated file, which a resuming job or the serving
+    snapshot poller would then try to load. fsync orders data before
+    the rename commit. One retry on OSError: transient blips (NFS
+    hiccups, chaos_ckpt_errors injection) should not abort a run whose
+    next attempt would succeed."""
+    for attempt in (0, 1):
+        try:
+            _chaos.ckpt_fault(path)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return
+        except OSError as e:
+            if attempt:
+                raise
+            log.warning("transient checkpoint IO error on %s (%s); "
+                        "retrying once", path, e)
 
 
 class Checkpointer:
@@ -78,18 +107,7 @@ class Checkpointer:
             leaves = jax.tree.leaves(jax.tree.map(_to_host, state))
             data = serialization.to_bytes(
                 {str(i): leaf for i, leaf in enumerate(leaves)})
-            path = self._path(version)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-                # fsync before the rename: os.replace alone makes the
-                # *name* atomic but not the *bytes* durable — after a
-                # power cut the new name can point at a truncated file,
-                # which the serving snapshot poller would then try to
-                # load. fsync orders data before the rename commit.
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            _commit_bytes(self._path(version), data)
         self._gc(version)
 
     lazy_save = save  # LazyCheckPoint: same commit, no extra copy needed
@@ -122,6 +140,28 @@ def _to_host(x):
         return np.asarray(x)
     except Exception:
         return x
+
+
+def reassemble_rows(blocks, global_rows: int):
+    """Global leading-axis rows from per-rank checkpoint blocks.
+
+    Two layouts exist in shard files: *partitioned* (each rank wrote a
+    disjoint contiguous row range; blocks concatenate in rank order) and
+    *replicated* (every rank wrote the full array — e.g. a table whose
+    sharded axis has size 1; any one copy is the array). Distinguished
+    by row counts, which is unambiguous: partitioned blocks sum to
+    ``global_rows``, replicated blocks each equal it (only a world of 1
+    satisfies both, and then the layouts coincide)."""
+    import numpy as np
+    total = sum(int(b.shape[0]) for b in blocks)
+    if total == int(global_rows):
+        return np.concatenate(blocks)
+    if all(int(b.shape[0]) == int(global_rows) for b in blocks):
+        return blocks[0]
+    raise ValueError(
+        f"cannot reshard: {len(blocks)} rank blocks with rows "
+        f"{[int(b.shape[0]) for b in blocks]} fit neither a partition "
+        f"nor replicas of {global_rows} global rows")
 
 
 class ShardCheckpointer:
@@ -160,7 +200,15 @@ class ShardCheckpointer:
         return os.path.join(self.dir, f"rank{self.rank}",
                             f"ckpt_v{version}.ok")
 
-    def save(self, version: int, state: Any) -> None:
+    def save(self, version: int, state: Any, barrier: bool = True) -> None:
+        """Commit this rank's shard of ``state`` as ``version``.
+
+        ``barrier=False`` is the drain path: a SIGTERMed survivor must
+        not wait on peers that may already be gone. Skipping the sync
+        is safe because a version only *wins* resume when EVERY
+        relaunched rank committed it — the caller's allreduce-min over
+        ``latest_version()`` is the real cross-rank agreement; the
+        barrier merely keeps healthy runs from racing ahead."""
         import jax
         import numpy as np
 
@@ -178,16 +226,19 @@ class ShardCheckpointer:
             leaves = jax.tree.leaves(jax.tree.map(local_block, state))
             data = serialization.to_bytes(
                 {str(i): leaf for i, leaf in enumerate(leaves)})
-            path = self._rank_path(version, self.rank)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
-            # all ranks must have committed before the version becomes valid
-            from jax.experimental import multihost_utils
-            with trace.span("collective:ckpt_barrier", cat="collective"):
-                multihost_utils.sync_global_devices(f"ckpt_v{version}")
-            open(self._marker(version), "w").close()
+            _commit_bytes(self._rank_path(version, self.rank), data)
+            if barrier:
+                # all ranks must have committed before the version
+                # becomes valid
+                from jax.experimental import multihost_utils
+                with trace.span("collective:ckpt_barrier", cat="collective"):
+                    with _watchdog.guard("ckpt_barrier"):
+                        multihost_utils.sync_global_devices(
+                            f"ckpt_v{version}")
+            # the marker is a commit record too: durable + atomic, so a
+            # crash between barrier and marker never leaves a marker
+            # pointing at unsynced bytes
+            _commit_bytes(self._marker(version), b"")
         self._gc(version)
 
     def load(self, template: Any,
@@ -196,6 +247,14 @@ class ShardCheckpointer:
         ver = self.latest_version() if version is None else version
         if ver == 0:
             return 0, template
+        prior = self._ranks_with(ver)
+        # Elastic resume: the checkpoint was written by a LARGER world
+        # (shrink relaunch after a dead rank). Detectable only on a
+        # shared filesystem, where every prior rank dir is visible as a
+        # full contiguous 0..P-1 set; a non-shared dir shows exactly one
+        # rank dir and takes the same-topology path below.
+        if len(prior) > self.world and prior == list(range(len(prior))):
+            return self._load_resharded(template, ver, len(prior))
         path = self._rank_path(ver, self.rank)
         with trace.span("checkpoint:shard_load", cat="checkpoint"):
             leaves, treedef = jax.tree.flatten(template)
@@ -214,6 +273,57 @@ class ShardCheckpointer:
                 treedef,
                 [restore_leaf(i, t) for i, t in enumerate(leaves)])
         log.info("restart from version=%d (%s)", ver, path)
+        return ver, state
+
+    def _ranks_with(self, version: int) -> list:
+        """Ranks whose data file for ``version`` is visible from here."""
+        if not self.dir or not os.path.isdir(self.dir):
+            return []
+        out = []
+        pat = re.compile(r"^rank(\d+)$")
+        for n in os.listdir(self.dir):
+            m = pat.match(n)
+            if m and os.path.exists(self._rank_path(version,
+                                                    int(m.group(1)))):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _load_resharded(self, template: Any, ver: int,
+                        prior_world: int) -> Tuple[int, Any]:
+        """Resume a checkpoint written by ``prior_world`` ranks into the
+        current (smaller) world: reassemble each sharded leaf's global
+        rows from the prior rank blocks, then slice this process's rows
+        under the NEW sharding. Rank blocks are leading-axis contiguous
+        ranges in rank order (the same layout ``save`` writes and the
+        store's ``_host_slot`` contiguity validation enforces)."""
+        import jax
+        import numpy as np
+        log.info("world changed %d -> %d: resharding checkpoint v%d",
+                 prior_world, self.world, ver)
+        with trace.span("checkpoint:shard_reshard", cat="checkpoint"):
+            leaves, treedef = jax.tree.flatten(template)
+            raws = []
+            for r in range(prior_world):
+                with open(self._rank_path(ver, r), "rb") as f:
+                    raws.append(serialization.msgpack_restore(f.read()))
+
+            def restore_leaf(i, tmpl):
+                if not (isinstance(tmpl, jax.Array)
+                        and not tmpl.is_fully_addressable):
+                    return raws[0][str(i)]
+                glob = reassemble_rows([raw[str(i)] for raw in raws],
+                                       int(tmpl.shape[0]))
+                spans = sorted({(s.index[0].start or 0,
+                                 s.index[0].stop if s.index[0].stop
+                                 is not None else int(tmpl.shape[0]))
+                                for s in tmpl.addressable_shards})
+                mine = np.concatenate([glob[a:b] for a, b in spans])
+                return jax.make_array_from_process_local_data(
+                    tmpl.sharding, mine)
+
+            state = jax.tree.unflatten(
+                treedef,
+                [restore_leaf(i, t) for i, t in enumerate(leaves)])
         return ver, state
 
     def latest_version(self) -> int:

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from wormhole_tpu.ft import chaos as _chaos
+from wormhole_tpu.ft import watchdog as _watchdog
 from wormhole_tpu.obs import trace
 
 # ---------------------------------------------------------------------------
@@ -146,26 +148,30 @@ def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils
-        npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
-        fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
-        chain = _resolve_chain(site, compress)
-        if chain is not None:
-            leaves, treedef = jax.tree.flatten(tree)
-            raw0, wire0 = (chain.stats["bytes_raw"],
-                           chain.stats["bytes_wire"])
-            out = [npfn(np.stack(
-                       _exchange_leaf(chain, site, i, x, op)), axis=0)
-                   for i, x in enumerate(leaves)]
-            if attrs is not None:
-                attrs["bytes_raw"] = chain.stats["bytes_raw"] - raw0
-                attrs["bytes_wire"] = chain.stats["bytes_wire"] - wire0
-            return jax.tree.unflatten(treedef, out)
+        # multi-process branch only: the fast path above keeps the
+        # watchdog/chaos hooks entirely off the single-process cost
+        _chaos.on_collective(site)
+        with _watchdog.guard(site or f"allreduce_{op}"):
+            npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
+            fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+            chain = _resolve_chain(site, compress)
+            if chain is not None:
+                leaves, treedef = jax.tree.flatten(tree)
+                raw0, wire0 = (chain.stats["bytes_raw"],
+                               chain.stats["bytes_wire"])
+                out = [npfn(np.stack(
+                           _exchange_leaf(chain, site, i, x, op)), axis=0)
+                       for i, x in enumerate(leaves)]
+                if attrs is not None:
+                    attrs["bytes_raw"] = chain.stats["bytes_raw"] - raw0
+                    attrs["bytes_wire"] = chain.stats["bytes_wire"] - wire0
+                return jax.tree.unflatten(treedef, out)
 
-        def reduce_leaf(x):
-            gathered = multihost_utils.process_allgather(jnp.asarray(x))
-            return np.asarray(fn(gathered, axis=0))
+            def reduce_leaf(x):
+                gathered = multihost_utils.process_allgather(jnp.asarray(x))
+                return np.asarray(fn(gathered, axis=0))
 
-        return jax.tree.map(reduce_leaf, tree)
+            return jax.tree.map(reduce_leaf, tree)
 
 
 def allgather_tree(tree: Any, mesh: Mesh, site: str = None) -> Any:
@@ -180,15 +186,17 @@ def allgather_tree(tree: Any, mesh: Mesh, site: str = None) -> Any:
         if jax.process_count() == 1:
             return jax.tree.map(lambda x: np.asarray(x)[None], tree)
         from jax.experimental import multihost_utils
-        chain = _resolve_chain(site, False)
-        if chain is not None:
-            leaves, treedef = jax.tree.flatten(tree)
-            out = [np.stack(_exchange_leaf(chain, site, i, x, "gather"))
-                   for i, x in enumerate(leaves)]
-            return jax.tree.unflatten(treedef, out)
-        return jax.tree.map(
-            lambda x: np.asarray(
-                multihost_utils.process_allgather(jnp.asarray(x))), tree)
+        _chaos.on_collective(site)
+        with _watchdog.guard(site or "allgather"):
+            chain = _resolve_chain(site, False)
+            if chain is not None:
+                leaves, treedef = jax.tree.flatten(tree)
+                out = [np.stack(_exchange_leaf(chain, site, i, x, "gather"))
+                       for i, x in enumerate(leaves)]
+                return jax.tree.unflatten(treedef, out)
+            return jax.tree.map(
+                lambda x: np.asarray(
+                    multihost_utils.process_allgather(jnp.asarray(x))), tree)
 
 
 def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
@@ -203,25 +211,27 @@ def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils
-        chain = _resolve_chain(site, False)
-        if chain is not None:
-            src = jax.process_index() == root
-            leaves, treedef = jax.tree.flatten(tree)
-            out = []
-            for i, x in enumerate(leaves):
-                buf = (chain.encode_leaf(site, i, x, "bcast")
-                       if src else b"")
-                n = int(np.asarray(multihost_utils.broadcast_one_to_all(
-                    np.int64(len(buf)), is_source=src)))
-                pad = np.zeros(n, np.uint8)
-                if src:
-                    pad[:len(buf)] = np.frombuffer(buf, np.uint8)
-                g = np.asarray(multihost_utils.broadcast_one_to_all(
-                    pad, is_source=src))
-                out.append(chain.decode_leaf(site, i, g.tobytes()))
-            return jax.tree.unflatten(treedef, out)
-        return multihost_utils.broadcast_one_to_all(
-            tree, is_source=jax.process_index() == root)
+        _chaos.on_collective(site)
+        with _watchdog.guard(site or "broadcast"):
+            chain = _resolve_chain(site, False)
+            if chain is not None:
+                src = jax.process_index() == root
+                leaves, treedef = jax.tree.flatten(tree)
+                out = []
+                for i, x in enumerate(leaves):
+                    buf = (chain.encode_leaf(site, i, x, "bcast")
+                           if src else b"")
+                    n = int(np.asarray(multihost_utils.broadcast_one_to_all(
+                        np.int64(len(buf)), is_source=src)))
+                    pad = np.zeros(n, np.uint8)
+                    if src:
+                        pad[:len(buf)] = np.frombuffer(buf, np.uint8)
+                    g = np.asarray(multihost_utils.broadcast_one_to_all(
+                        pad, is_source=src))
+                    out.append(chain.decode_leaf(site, i, g.tobytes()))
+                return jax.tree.unflatten(treedef, out)
+            return multihost_utils.broadcast_one_to_all(
+                tree, is_source=jax.process_index() == root)
 
 
 def host_local_to_global(tree: Any, mesh: Mesh, pspec) -> Any:

@@ -85,14 +85,29 @@ def _pump_lines(stream, sink, lock, tag: bytes = b"") -> None:
     stream.close()
 
 
+def _attempt_dir(directory: str, attempt: int) -> str:
+    """Telemetry dir for one launch attempt. Attempt 0 keeps the base
+    dir (single-launch runs are unchanged); relaunches namespace
+    ``attempt<k>/`` so a retry never clobbers — or gets mixed into —
+    the previous attempt's heartbeat/trace files (obs/merge.py and
+    scripts/bench_check.py read the latest attempt)."""
+    if not directory or attempt <= 0:
+        return directory
+    return os.path.join(directory, f"attempt{attempt}")
+
+
 def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
-              straggler_factor: float = 3.0, trace_dir: str = "") -> int:
+              straggler_factor: float = 3.0, trace_dir: str = "",
+              attempt: int = 0, supervisor=None,
+              comm_timeout_s: float = 0.0, drain: bool = False) -> int:
     import threading
     port = _free_port()
     procs = []
     pumps = []
     out_lock = threading.Lock()
     monitor = None
+    heartbeat_dir = _attempt_dir(heartbeat_dir, attempt)
+    trace_dir = _attempt_dir(trace_dir, attempt)
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
     if heartbeat_dir:
@@ -121,6 +136,15 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["NUM_PROCESSES"] = str(n)
         env["PROCESS_ID"] = str(i)
+        # relaunch attempt index: chaos injection (ft/chaos.py) fires
+        # only on attempt 0, so a supervised retry comes up clean
+        env["WORMHOLE_ATTEMPT"] = str(attempt)
+        if comm_timeout_s > 0:
+            env["WORMHOLE_COMM_TIMEOUT_S"] = str(comm_timeout_s)
+        if drain:
+            # opt-in SIGTERM→drain in the workers; unconditional install
+            # would change plain `kill` semantics for unsupervised runs
+            env["WORMHOLE_FT_DRAIN"] = "1"
         if heartbeat_dir:
             env["WORMHOLE_METRICS_EXPORT"] = heartbeat_dir
         if trace_dir:
@@ -146,19 +170,39 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
         # failed JOB exits promptly and a restart can rebuild the whole
         # mesh (SURVEY §5.3 recovery model; waiting on the jax
         # coordination-service heartbeat instead costs minutes)
-        live = list(procs)
+        live = dict(enumerate(procs))  # rank -> proc
+        last_scan = _time.monotonic()
         while live:
-            for p in list(live):
+            for r, p in sorted(live.items()):
                 code = p.poll()
                 if code is None:
                     continue
-                live.remove(p)
+                del live[r]
+                if supervisor is not None:
+                    supervisor.record_exit(r, code)
                 rc = rc or code   # first failure wins (terminated
                                   # bystanders exit -15 and must not
                                   # mask the originating code)
                 if code != 0:
-                    for q in live:
+                    for q in live.values():
                         q.terminate()
+            now = _time.monotonic()
+            if supervisor is not None and heartbeat_dir \
+                    and now - last_scan >= 1.0:
+                # a hung (not crashed) rank never exits on its own:
+                # declare it dead on heartbeat silence and SIGKILL it,
+                # which the loop above then handles like any crash
+                last_scan = now
+                for r in supervisor.scan_heartbeats(heartbeat_dir):
+                    p = live.get(r)
+                    if p is not None and p.poll() is None:
+                        with out_lock:
+                            sys.stderr.write(
+                                f"[launcher] rank {r} heartbeat-silent > "
+                                f"{supervisor.detector.dead_after_s:.0f}s; "
+                                "declared dead, killing\n")
+                            sys.stderr.flush()
+                        p.kill()
             _time.sleep(0.1)
     finally:
         for p in procs:
@@ -209,6 +253,38 @@ def _merge_rank_traces(trace_dir: str, heartbeat_dir: str,
         emit(f"[launcher] trace merge failed: {e!r}")
 
 
+def launch_mp_supervised(n: int, cmd: List[str], restarts: int = 0,
+                         heartbeat_dir: str = "",
+                         straggler_factor: float = 3.0,
+                         trace_dir: str = "", dead_after_s: float = 0.0,
+                         elastic: str = "fixed",
+                         comm_timeout_s: float = 0.0) -> int:
+    """Supervised mp job: detection → drain → relaunch.
+
+    Each attempt runs with the SIGTERM-drain protocol enabled and the
+    supervisor watching heartbeats; on failure the world is relaunched
+    (shrunk to the survivors under ``elastic="shrink"``) up to
+    ``restarts`` times, resuming from the last committed checkpoint
+    version. See docs/fault_tolerance.md for the state machine."""
+    from wormhole_tpu.ft.supervisor import Supervisor
+    sup = Supervisor(n, elastic=elastic, dead_after_s=dead_after_s)
+    attempt = 0
+    while True:
+        rc = launch_mp(sup.world, cmd, heartbeat_dir=heartbeat_dir,
+                       straggler_factor=straggler_factor,
+                       trace_dir=trace_dir, attempt=attempt,
+                       supervisor=sup, comm_timeout_s=comm_timeout_s,
+                       drain=True)
+        if rc == 0 or attempt >= restarts:
+            return rc
+        dead = sorted(sup.dead)
+        world = sup.plan_relaunch()
+        attempt += 1
+        print(f"[launcher] rank(s) {dead or 'unknown'} lost (rc={rc}); "
+              f"supervised relaunch {attempt}/{restarts} with "
+              f"world={world} ({elastic})", file=sys.stderr)
+
+
 def launch_tpu(cmd: List[str]) -> int:
     # On a pod slice each host runs this identically; JAX's TPU runtime
     # discovers topology itself. Nothing to inject.
@@ -238,6 +314,21 @@ def main(argv: List[str] = None) -> int:
                          "into it and the launcher merges the files at "
                          "exit into merged.trace.json + a collective "
                          "skew report")
+    ap.add_argument("--ft-dead-after", type=float, default=0.0,
+                    help="mp only: supervised fault tolerance — declare "
+                         "a rank dead after S seconds of heartbeat "
+                         "silence, SIGTERM-drain the survivors and "
+                         "relaunch (uses the --restarts budget). 0 = "
+                         "unsupervised (plain whole-job restarts)")
+    ap.add_argument("--ft-elastic", choices=("fixed", "shrink"),
+                    default="fixed",
+                    help="supervised relaunch geometry: same world size "
+                         "(fixed) or shrink to the survivors")
+    ap.add_argument("--comm-timeout", type=float, default=0.0,
+                    help="mp only: exported collective watchdog timeout "
+                         "(WORMHOLE_COMM_TIMEOUT_S) — a worker blocked "
+                         "in a host collective longer than S seconds "
+                         "exits with PEER_LOST instead of hanging")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- command to launch")
     args = ap.parse_args(argv)
@@ -246,20 +337,29 @@ def main(argv: List[str] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("no command given (append: -- python app.py ...)")
-    run = {"sim": lambda: launch_sim(args.num_devices, cmd),
-           "mp": lambda: launch_mp(args.num_devices, cmd,
-                                   heartbeat_dir=args.heartbeat_dir,
-                                   straggler_factor=args.straggler_factor,
-                                   trace_dir=args.trace_dir),
-           "tpu": lambda: launch_tpu(cmd)}[args.cluster]
-    rc = run()
+    if args.cluster == "mp" and args.ft_dead_after > 0:
+        return launch_mp_supervised(
+            args.num_devices, cmd, restarts=args.restarts,
+            heartbeat_dir=args.heartbeat_dir,
+            straggler_factor=args.straggler_factor,
+            trace_dir=args.trace_dir, dead_after_s=args.ft_dead_after,
+            elastic=args.ft_elastic, comm_timeout_s=args.comm_timeout)
+    run = {"sim": lambda a: launch_sim(args.num_devices, cmd),
+           "mp": lambda a: launch_mp(args.num_devices, cmd,
+                                     heartbeat_dir=args.heartbeat_dir,
+                                     straggler_factor=args.straggler_factor,
+                                     trace_dir=args.trace_dir,
+                                     attempt=a,
+                                     comm_timeout_s=args.comm_timeout),
+           "tpu": lambda a: launch_tpu(cmd)}[args.cluster]
+    rc = run(0)
     attempt = 0
     while rc != 0 and attempt < args.restarts:
         attempt += 1
         print(f"[launcher] job failed (rc={rc}); restart "
               f"{attempt}/{args.restarts} — checkpointed apps resume",
               file=sys.stderr)
-        rc = run()
+        rc = run(attempt)
     return rc
 
 

@@ -207,7 +207,13 @@ class ReplicatedRounds:
     def produced(self, nblocks: int) -> None:
         """Count blocks THIS host dispatched since the last status row
         (claim-round blocks ride the next row; by the time a part is old
-        enough to look like a straggler they are long since credited)."""
+        enough to look like a straggler they are long since credited).
+
+        Also the chaos kill site (ft/chaos.py): "kill rank r at block k"
+        is defined in units of this counter, which makes the injection
+        point deterministic for a given data/partition layout."""
+        from wormhole_tpu.ft import chaos
+        chaos.tick_block(int(nblocks))
         self._my_unreported += int(nblocks)
 
     def status_row(self, finished_id: int, need: bool,

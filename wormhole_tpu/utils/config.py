@@ -185,6 +185,27 @@ class Config:
     serve_predict: bool = True    # route offline predict() TEST margins
                                   # through the pull-only serve forward
                                   # (eval_step stays the metrics oracle)
+    # --- fault tolerance (wormhole_tpu/ft; all off by default) ---
+    # collective watchdog: a survivor blocked in a host collective longer
+    # than this many seconds exits with the distinguished PEER_LOST code
+    # (117) instead of hanging on a dead peer. 0 = no watchdog thread.
+    # See docs/fault_tolerance.md.
+    comm_timeout_s: float = 0.0
+    # supervised launch_mp (mirrored by --ft-dead-after): declare a rank
+    # dead after this many seconds of heartbeat silence and trigger the
+    # drain + relaunch cycle. 0 = unsupervised.
+    ft_dead_after_s: float = 0.0
+    # relaunch geometry after a dead rank: "fixed" re-runs at the same
+    # world size, "shrink" drops to the survivors (floor 2)
+    ft_elastic: str = "fixed"
+    # --- chaos fault injection (ft/chaos.py; inert unless set, and only
+    # ever fires on attempt 0 of a supervised run) ---
+    chaos_kill_rank: int = -1     # SIGKILL this rank (-1 = off) ...
+    chaos_kill_block: int = 0     # ... once it has produced this many blocks
+    chaos_delay_rank: int = -1    # rank receiving the injected delays below
+    chaos_collective_delay_s: float = 0.0  # sleep before each host collective
+    chaos_heartbeat_delay_s: float = 0.0   # sleep inside each heartbeat write
+    chaos_ckpt_errors: int = 0    # transient checkpoint-IO errors to inject
 
     def merged(self, kvs: Sequence[str]) -> "Config":
         """Return a copy with ``key=value`` tokens merged over this config."""

@@ -910,6 +910,71 @@ def bench_comm_filters() -> dict:
     return out
 
 
+def bench_async_ps() -> dict:
+    """Bounded-staleness exchange engine (wormhole_tpu/ps): window
+    throughput vs ``staleness_tau`` on a synthetic stream where the
+    simulated device step and the simulated wire round-trip are
+    comparable — the regime the engine exists for. The engine is real
+    (drain thread, gate-by-count, measured delays); the transport is a
+    sleep plus ``FilterChain.roundtrip`` on the "ps/delta" site, so the
+    wire-byte accounting exercises the exact codec the multihost path
+    ships through. tau=0 serializes compute and exchange; tau>=1 must
+    overlap them (ex_per_sec strictly above tau=0, overlap_frac > 0) —
+    scripts/bench_check.py auto-gates every *_ex_per_sec key."""
+    from wormhole_tpu.parallel.filters import FilterChain
+    from wormhole_tpu.ps import ExchangeEngine
+    rng = np.random.default_rng(5)
+    nb = 1 << 16
+    windows = 24
+    mb = 1024               # examples per window
+    t_compute = 0.010       # simulated device step per window
+    t_wire = 0.010          # simulated DCN latency per exchange
+    grads = []
+    for _ in range(4):
+        g = np.zeros(nb, np.float32)
+        idx = rng.integers(0, nb, size=4096)
+        g[idx] = rng.standard_normal(idx.size).astype(np.float32)
+        grads.append(g)
+    out = {"windows": windows, "examples_per_window": mb,
+           "sim_compute_s": t_compute, "sim_wire_s": t_wire}
+    for tau in (0, 1, 2):
+        chain = FilterChain(filters={"key_caching", "fixing_float",
+                                     "compressing"}, quant_bits=8,
+                            min_bytes=0)
+        eng = ExchangeEngine(tau)
+        applied = 0
+        t0 = time.perf_counter()
+        try:
+            for i in range(windows):
+                time.sleep(t_compute)               # the device step
+                g = grads[i % len(grads)]
+                eng.submit(lambda g=g: (time.sleep(t_wire),
+                                        chain.roundtrip(g, "ps/delta"))[1])
+                for tk in eng.gate():
+                    eng.note_applied(tk)
+                    applied += 1
+            for tk in eng.quiesce():
+                eng.note_applied(tk)
+                applied += 1
+        finally:
+            eng.stop()
+        wall = time.perf_counter() - t0
+        assert applied == windows
+        key = f"tau{tau}"
+        out[f"{key}_ex_per_sec"] = round(windows * mb / wall, 1)
+        out[f"{key}_overlap_frac"] = round(
+            eng.delays.overlap_fraction(), 4)
+        out[f"{key}_wall_s"] = round(wall, 3)
+        out[f"{key}_bytes_wire"] = chain.stats["bytes_wire"]
+        out[f"{key}_wire_ratio"] = round(chain.ratio(), 2)
+        if _deadline_passed():
+            out["budget_truncated"] = True
+            return out
+    out["overlap_speedup"] = round(
+        out["tau1_ex_per_sec"] / max(out["tau0_ex_per_sec"], 1e-9), 3)
+    return out
+
+
 def bench_scale_curve(workdir: str, rng) -> list:
     """Tile-step rate vs model size (VERDICT r4 Missing #3): the crec2
     pairs array scales as tiles x cap with cap floored at 128, so at
@@ -1277,8 +1342,8 @@ def bench_chaos() -> dict:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "device_sparse", "device_dense_apply",
-          "scale_curve", "serve", "comm_filters", "kmeans", "lbfgs",
-          "gbdt", "chaos"]
+          "scale_curve", "serve", "comm_filters", "async_ps", "kmeans",
+          "lbfgs", "gbdt", "chaos"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1382,6 +1447,10 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
         extra["comm_filters"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in results["comm_filters"].items()}
+    if "async_ps" in results:
+        extra["async_ps"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in results["async_ps"].items()}
     for name, key in (("kmeans", "kmeans_mnist784"),
                       ("lbfgs", "lbfgs_rcv1"),
                       ("gbdt", "gbdt_higgs200k")):
@@ -1509,6 +1578,7 @@ def main(argv=None) -> None:
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
         "serve": bench_serve,
         "comm_filters": bench_comm_filters,
+        "async_ps": bench_async_ps,
         "kmeans": bench_kmeans,
         "lbfgs": bench_lbfgs,
         "gbdt": bench_gbdt,

@@ -1,20 +1,34 @@
 #!/usr/bin/env python
-"""Lint: no direct ``multihost_utils`` use outside wormhole_tpu/parallel/.
+"""Lint: no direct ``multihost_utils`` use outside wormhole_tpu/parallel/,
+and every learners/ collective call site audited for engine routing.
 
-Every host-level DCN hop must go through parallel/collectives.py
-(``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree`` /
-``host_local_to_global``): that is where the ps-lite filter chain
-(parallel/filters.py — KEY_CACHING / FIXING_FLOAT / COMPRESSING) and the
-wire-byte accounting (``comm/bytes_raw`` etc.) live. A call site that
-imports ``jax.experimental.multihost_utils`` directly bypasses both —
-its payload ships unfiltered and its bytes vanish from the comm
-counters — so this lint fails the build until the site is rewritten
-against the wrappers or consciously allowlisted with a reason.
+Rule 1 — every host-level DCN hop must go through
+parallel/collectives.py (``allreduce_tree`` / ``allgather_tree`` /
+``broadcast_tree`` / ``host_local_to_global``): that is where the
+ps-lite filter chain (parallel/filters.py — KEY_CACHING / FIXING_FLOAT
+/ COMPRESSING) and the wire-byte accounting (``comm/bytes_raw`` etc.)
+live. A call site that imports ``jax.experimental.multihost_utils``
+directly bypasses both — its payload ships unfiltered and its bytes
+vanish from the comm counters — so this lint fails the build until the
+site is rewritten against the wrappers or consciously allowlisted with
+a reason.
 
-The check is textual (comments stripped), not an AST walk: it must
-catch the module name inside lazy function-level imports and strings
-being exec'd too, and false positives are resolved by the allowlist
-anyway.
+Rule 2 — with the bounded-staleness engine (wormhole_tpu/ps/) live, a
+training pass may only issue host collectives from the engine's single
+drain thread: a second thread issuing its own collective can interleave
+differently across ranks and deadlock the mesh. Every
+``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree`` call site
+under ``wormhole_tpu/learners/`` must therefore carry an audit marker
+within the preceding few lines: ``# ps-engine:`` (the call routes
+through ``ExchangeEngine.submit/exchange`` — e.g. via ``_ctl``) or
+``# bsp-direct:`` (the call provably never coexists with a live
+engine, e.g. the crec BSP pass the engine dispatch excludes). An
+unmarked site means nobody decided, which is how the deadlock ships.
+
+The checks are textual (rule 1 strips comments; rule 2 reads them),
+not an AST walk: they must catch lazy function-level imports and
+closures built inside call arguments, and false positives are resolved
+by the allowlist / a marker anyway.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -36,6 +50,12 @@ ALLOWLIST: dict = {}
 
 _PAT = re.compile(r"\bmultihost_utils\b")
 
+# rule 2: learners/ collective call sites and their audit markers
+_CALL_PAT = re.compile(
+    r"\b(allreduce_tree|allgather_tree|broadcast_tree)\s*\(")
+_MARKER_PAT = re.compile(r"#\s*(ps-engine|bsp-direct):")
+_MARKER_WINDOW = 3   # marker may sit up to this many lines above the call
+
 
 def _strip_comments(text: str) -> str:
     """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive about
@@ -52,6 +72,26 @@ def scan_file(path: str) -> list:
             for m in _PAT.finditer(text)]
 
 
+def scan_markers(path: str) -> list:
+    """Rule 2: return ``(line, callee)`` for every collective call site
+    without a ``# ps-engine:`` / ``# bsp-direct:`` audit marker on the
+    call line or the :data:`_MARKER_WINDOW` lines above it."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        raw = f.read()
+    raw_lines = raw.splitlines()
+    code_lines = _strip_comments(raw).splitlines()
+    out = []
+    for i, ln in enumerate(code_lines):
+        m = _CALL_PAT.search(ln)
+        if m is None:
+            continue
+        lo = max(0, i - _MARKER_WINDOW)
+        if any(_MARKER_PAT.search(r) for r in raw_lines[lo:i + 1]):
+            continue
+        out.append((i + 1, m.group(1)))
+    return out
+
+
 def run(root: str) -> int:
     """Scan ``root``/wormhole_tpu for violations; return a process rc."""
     pkg = os.path.join(root, "wormhole_tpu")
@@ -60,6 +100,7 @@ def run(root: str) -> int:
               file=sys.stderr)
         return 2
     violations = []
+    unmarked = []
     seen_allowed = set()
     for dirpath, _dirnames, filenames in os.walk(pkg):
         for fn in sorted(filenames):
@@ -69,6 +110,9 @@ def run(root: str) -> int:
             rel = os.path.relpath(path, root).replace(os.sep, "/")
             if rel.startswith("wormhole_tpu/parallel/"):
                 continue  # parallel/ owns the raw transport
+            if rel.startswith("wormhole_tpu/learners/"):
+                unmarked.extend(f"{rel}:{ln} ({name})"
+                                for ln, name in scan_markers(path))
             lines = scan_file(path)
             if not lines:
                 continue
@@ -91,6 +135,18 @@ def run(root: str) -> int:
               "host_local_to_global) so it rides the filter chain and "
               "the comm byte counters, or add the file to ALLOWLIST in "
               "scripts/lint_collectives.py with a reason",
+              file=sys.stderr)
+        return 1
+    if unmarked:
+        print("lint_collectives: learners/ collective call sites without "
+              "an engine-routing audit marker:", file=sys.stderr)
+        for v in unmarked:
+            print(f"  {v}", file=sys.stderr)
+        print("mark the site `# ps-engine:` (it runs on the exchange "
+              "engine's drain thread — ExchangeEngine.submit/exchange, "
+              "e.g. via AsyncSGD._ctl) or `# bsp-direct:` (it provably "
+              "never coexists with a live engine) within "
+              f"{_MARKER_WINDOW} lines above the call",
               file=sys.stderr)
         return 1
     print(f"lint_collectives: OK ({len(seen_allowed)} allowlisted files)")

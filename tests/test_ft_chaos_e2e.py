@@ -134,6 +134,39 @@ def test_mp_chaos_collective_delay_trips_watchdog(tmp_path):
     assert r.stdout.count("OK rank") == 2, r.stdout
 
 
+def test_mp_chaos_ps_engine_delay_trips_watchdog_on_drain_thread(tmp_path):
+    """The ps-engine drill: training runs through the bounded-staleness
+    exchange engine (staleness_tau=2), so every collective of the TRAIN
+    pass executes on the engine's drain thread — including the watchdog
+    arm/disarm around it (per-thread slots, ft/watchdog.py). With a peer
+    delayed far past comm_timeout_s the survivor's watchdog must fire
+    PEER_LOST (117) from that background thread, and the supervised
+    relaunch still completes the job."""
+    rng = np.random.default_rng(53)
+    pattern = _learnable_libsvm(tmp_path, rng, n_files=1, rows=200)
+    r = run_mp(2, _body(_cfg(tmp_path, pattern, "ps_delay",
+                             ["algo=dt_adagrad", "staleness_tau=2",
+                              "chaos_delay_rank=1",
+                              "chaos_collective_delay_s=8"])),
+               timeout=600, raw=True,
+               launcher_args=("--restarts", "1",
+                              "--ft-dead-after", "60",
+                              "--ft-elastic", "fixed",
+                              "--comm-timeout", "1.5",
+                              "--heartbeat-dir",
+                              str(tmp_path / "hb_ps_delay")))
+    _skip_if_no_mp(r)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the engine path was actually live on the faulted attempt
+    assert "ps engine on: staleness_tau=2" in r.stderr, r.stderr
+    # the survivor abandoned the blocked exchange with the
+    # distinguished code instead of hanging for the full delay
+    assert "peer presumed lost" in r.stderr, r.stderr
+    assert "supervised relaunch" in r.stderr, r.stderr
+    assert "world=2" in r.stderr, r.stderr
+    assert r.stdout.count("OK rank") == 2, r.stdout
+
+
 def test_mp_chaos_transient_ckpt_io_recovers_inline(tmp_path):
     """A transient checkpoint-IO error is absorbed by the commit
     helper's single retry: the run completes with rc 0, no relaunch

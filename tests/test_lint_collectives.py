@@ -49,6 +49,46 @@ def test_synthetic_violation_caught(tmp_path):
     assert "wormhole_tpu/bad.py:3" in r.stderr
 
 
+def test_unmarked_learner_collective_caught(tmp_path):
+    # rule 2: a learners/ collective call site without a routing marker
+    # fails — nobody decided which thread issues it
+    pkg = tmp_path / "wormhole_tpu" / "learners"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "from wormhole_tpu.parallel.collectives import allreduce_tree\n"
+        "def f(x, mesh):\n"
+        "    return allreduce_tree(x, mesh, 'sum', site='x')\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "learners/bad.py:3 (allreduce_tree)" in r.stderr
+    assert "ps-engine" in r.stderr
+
+
+def test_marked_learner_collective_passes(tmp_path):
+    # both markers satisfy rule 2, on the line or within 3 lines above
+    pkg = tmp_path / "wormhole_tpu" / "learners"
+    pkg.mkdir(parents=True)
+    (pkg / "ok.py").write_text(
+        "from wormhole_tpu.parallel.collectives import (allreduce_tree,\n"
+        "                                               allgather_tree)\n"
+        "def f(x, mesh, eng):\n"
+        "    return eng.exchange(\n"
+        "        # ps-engine: control exchange on the drain thread\n"
+        "        lambda: allreduce_tree(x, mesh, 'sum', site='x'))\n"
+        "def g(x, mesh):\n"
+        "    # bsp-direct: crec pass never runs with a live engine\n"
+        "    return allgather_tree(x, mesh, site='y')\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    # the import lines are call-free and must not need markers
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_collectives
+    finally:
+        sys.path.pop(0)
+    assert lint_collectives.scan_markers(str(pkg / "ok.py")) == []
+
+
 def test_parallel_dir_is_exempt(tmp_path):
     pkg = tmp_path / "wormhole_tpu" / "parallel"
     pkg.mkdir(parents=True)

@@ -304,7 +304,14 @@ def default_cap(nnz: int, nb: int) -> int:
     ~0.01 pairs per cell — negligible; the kernel cost scales linearly
     with cap, so tighter is faster)."""
     from wormhole_tpu.ops.tilemm import RSUB, TILE
-    mean = RSUB * nnz / (nb // TILE)
+    tiles = nb // TILE
+    if not tiles:
+        # ValueError, not ZeroDivisionError: callers probe tile
+        # admissibility by construction (online_info docstring) and a
+        # sub-tile bucket table is an inadmissible geometry like any other
+        raise ValueError(f"nb={nb} is smaller than one tile "
+                         f"({TILE} buckets)")
+    mean = RSUB * nnz / tiles
     return max(128, int(-(-(mean + 3 * mean ** 0.5) // 128)) * 128)
 
 

@@ -39,9 +39,15 @@ COMM_TIMEOUT_ENV = "WORMHOLE_COMM_TIMEOUT_S"
 class CollectiveWatchdog:
     """One monitor thread, armed/disarmed around blocking collectives.
 
-    Arm/disarm is generation-counted so a stale wakeup of the monitor
-    thread (scheduled before a disarm, delivered after a re-arm) can
-    never fire against the wrong collective.
+    One armed slot PER CALLING THREAD: the ps exchange engine runs its
+    collectives on its own thread while the training loop still arms
+    around the control-plane exchanges, so arm/disarm must not clobber
+    across threads. Each ``arm`` replaces only the calling thread's
+    slot (re-arm resets that slot's deadline); ``disarm`` clears it.
+    The monitor fires on the earliest expired slot of any thread —
+    recomputing deadlines from the live slot map on every wakeup, so a
+    stale wakeup (scheduled before a disarm, delivered after a re-arm)
+    can never fire against the wrong collective.
     """
 
     def __init__(self, timeout_s: float,
@@ -49,10 +55,9 @@ class CollectiveWatchdog:
         self.timeout_s = float(timeout_s)
         self._exit = exit_fn if exit_fn is not None else self._default_exit
         self._cv = threading.Condition()
-        self._gen = 0
-        self._armed_gen: Optional[int] = None
-        self._site = ""
-        self._deadline = 0.0
+        # thread ident -> (site, deadline); presence in the map IS the
+        # armed state, so removal doubles as the stale-wakeup guard
+        self._armed: dict = {}
         self._stopped = False
         self.fired_site: Optional[str] = None
         self._thread = threading.Thread(
@@ -69,15 +74,13 @@ class CollectiveWatchdog:
 
     def arm(self, site: str) -> None:
         with self._cv:
-            self._gen += 1
-            self._armed_gen = self._gen
-            self._site = str(site)
-            self._deadline = time.monotonic() + self.timeout_s
+            self._armed[threading.get_ident()] = (
+                str(site), time.monotonic() + self.timeout_s)
             self._cv.notify()
 
     def disarm(self) -> None:
         with self._cv:
-            self._armed_gen = None
+            self._armed.pop(threading.get_ident(), None)
             self._cv.notify()
 
     @contextlib.contextmanager
@@ -92,25 +95,26 @@ class CollectiveWatchdog:
         """Shut the monitor thread down (tests; production exits instead)."""
         with self._cv:
             self._stopped = True
-            self._armed_gen = None
+            self._armed.clear()
             self._cv.notify()
         self._thread.join(timeout=5.0)
 
     def _loop(self) -> None:
         with self._cv:
             while not self._stopped:
-                if self._armed_gen is None:
+                if not self._armed:
                     self._cv.wait()
                     continue
-                gen = self._armed_gen
-                remaining = self._deadline - time.monotonic()
-                if remaining > 0:
-                    self._cv.wait(timeout=remaining)
+                now = time.monotonic()
+                expired = [(dl, tid, site)
+                           for tid, (site, dl) in self._armed.items()
+                           if dl <= now]
+                if not expired:
+                    nxt = min(dl for _, dl in self._armed.values())
+                    self._cv.wait(timeout=nxt - now)
                     continue
-                if self._armed_gen != gen:
-                    continue  # stale wakeup: disarmed/re-armed meanwhile
-                site = self._site
-                self._armed_gen = None
+                _, tid, site = min(expired)
+                del self._armed[tid]
                 self.fired_site = site
                 # exit_fn normally never returns (os._exit); tests inject
                 # a recorder, in which case keep monitoring

@@ -953,7 +953,10 @@ class AsyncSGD:
 
     def run(self) -> Progress:
         """Pass/workload loop (AsyncSGDScheduler::Run, async_sgd.h:294-348)."""
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 or self.cfg.staleness_tau >= 0:
+            # the ps engine path shares the multihost pass structure even
+            # on one process (the collectives take their identity fast
+            # paths; the staleness semantics are what the knob buys)
             return self.run_multihost()
         run_t0 = time.monotonic()   # obs ledger: measured run wall time
         cfg = self.cfg
@@ -1144,6 +1147,125 @@ class AsyncSGD:
             uniq_keys=np.zeros(cfg.key_pad, np.int32),
             key_mask=np.zeros(cfg.key_pad, np.float32))
 
+    # -- bounded-staleness engine pass (wormhole_tpu/ps) ---------------------
+    #
+    # With cfg.staleness_tau >= 0 the TRAIN exchange leaves the trainer
+    # thread: every gradient window ships as a dense bucket-space delta
+    # through the ExchangeEngine's drain thread, and the loop runs up to
+    # tau windows ahead before the gate blocks. Two invariants carry the
+    # correctness (ps/engine.py): ALL host collectives route through the
+    # one engine thread in deterministic program order, and completed
+    # windows are consumed by COUNT, never by completion timing — so
+    # every rank applies the same windows at the same loop points and
+    # the pass terminates after identical submission counts everywhere.
+    #
+    # Work distribution is STATIC here (round-robin parts per rank,
+    # WorkloadPool.take_static) where the BSP passes run the dynamic
+    # claim protocol: the pool's per-round control collective exists to
+    # absorb stragglers, and bounded staleness already does that — a
+    # slow rank delays the windows it contributes to, not the whole
+    # lockstep round. Control-plane data the pass still needs (global
+    # drain agreement, pass metrics) piggybacks ON the delta payload:
+    # the sum-allreduce of per-rank scalars IS the control exchange, at
+    # zero extra round trips — stale by at most tau windows, which only
+    # costs tau trailing empty windows at the end of the pass.
+
+    def _ctl(self, fn):
+        """Run one control-plane host collective: through the engine's
+        drain thread when the ps engine is live (preserving the single
+        global collective order), else inline on the caller."""
+        eng = getattr(self, "_engine", None)
+        return eng.exchange(fn) if eng is not None else fn()
+
+    def _ps_apply(self, ticket, local: Progress) -> bool:
+        """Apply one completed delta window to the store and fold its
+        globally-summed metrics; True when the window proves the pass
+        globally drained (no rank fed a real batch into it)."""
+        res = ticket.result
+        tau = self._engine.note_applied(ticket)
+        with obs.trace.span("ps:apply", cat="ps",
+                            args={"tau": tau}):
+            self.store.ps_push(res["grad"], tau=float(tau))
+        m = np.asarray(res["metrics"], np.float64)
+        if m[1] > 0:
+            local.objv += float(m[0])
+            local.num_ex += int(m[1])
+            local.count += 1
+            # auc/acc shipped example-weighted so the global sum
+            # renormalizes to the window's exact pooled fraction
+            local.auc += float(m[2]) / m[1]
+            local.acc += float(m[3]) / m[1]
+            self._display(local)
+        return int(res["have"]) == 0
+
+    def _multihost_pass_ps(self, pattern: str) -> Progress:
+        """One TRAIN pass through the bounded-staleness engine."""
+        from wormhole_tpu.parallel.collectives import allreduce_tree
+        cfg = self.cfg
+        engine = self._engine
+        nb = cfg.num_buckets
+        local = Progress()
+        pool = WorkloadPool()
+        pool.add(pattern, cfg.num_parts_per_file, TRAIN)
+        mine = pool.take_static(self.rt.world, self.rt.rank)
+
+        def batches():
+            for wl in mine:
+                yield from self._batches(wl.file, wl.part, wl.nparts)
+
+        it = batches()
+        window = max(1, cfg.ps_window_steps)
+        stop = False
+        while not stop:
+            if ft_supervisor.drain_requested():
+                # flush in-flight windows into the store before the
+                # survivor checkpoint commits (run_multihost's handler)
+                with self.timer.scope("wait"):
+                    for tk in engine.quiesce():
+                        self._ps_apply(tk, local)
+                raise ft_supervisor.DrainInterrupt()
+            # one window = up to ps_window_steps minibatch gradients, all
+            # taken at the same weights, accumulated into one delta
+            dense = np.zeros(nb, np.float32)
+            mets = np.zeros(4, np.float64)
+            have_local = False
+            for _ in range(window):
+                with self.timer.scope("parse"):
+                    blk = next(it, None)
+                real = blk is not None
+                have_local = have_local or real
+                batch = blk if real else self._empty_local_batch()
+                with self.timer.scope("dispatch"):
+                    grad, _snap, m = self.store.dt2_pull(batch)
+                    # host scatter to the dense exchange space: the
+                    # per-uniq-key gradient lands in bucket coordinates
+                    # that are identical on every rank (COMPRESSING's
+                    # zero-RLE eats the untouched tail on the wire)
+                    np.add.at(dense, np.asarray(batch.uniq_keys),
+                              np.asarray(grad) * np.asarray(batch.key_mask))
+                    nex = float(np.asarray(m[1]))
+                    mets += [float(np.asarray(m[0])), nex,
+                             float(np.asarray(m[2])) * nex,
+                             float(np.asarray(m[3])) * nex]
+                if not real:
+                    break   # local tail: no more empties in this window
+            payload = {
+                "grad": dense,
+                "metrics": mets.astype(np.float32),
+                "have": np.int64(have_local),
+            }
+            engine.submit(
+                # ps-engine: the closure executes on the drain thread
+                lambda p=payload: allreduce_tree(
+                    p, self.rt.mesh, "sum", site="ps/delta"))
+            with self.timer.scope("wait"):
+                for tk in engine.gate():
+                    stop = self._ps_apply(tk, local) or stop
+        with self.timer.scope("wait"):
+            for tk in engine.quiesce():
+                self._ps_apply(tk, local)
+        return local
+
     def _multihost_pass(self, pattern: str, kind: str,
                         pooled: Optional[list] = None) -> Progress:
         """One synchronized pass over ``pattern`` with the replicated
@@ -1199,9 +1321,11 @@ class AsyncSGD:
             need = my_it is None
             # one exchange per global step:
             # (finished part, need, drained, blocks contributed)
-            status = allgather_tree(
-                rr.status_row(finished_id, need, drained),
-                self.rt.mesh, site="async_sgd/status")
+            status = self._ctl(
+                # ps-engine: control exchange on the drain thread
+                lambda: allgather_tree(
+                    rr.status_row(finished_id, need, drained),
+                    self.rt.mesh, site="async_sgd/status"))
             finished_id = -1
             rr.advance(status)
             # identical pool transitions on every replica, in rank order
@@ -1246,9 +1370,11 @@ class AsyncSGD:
                         my_it = None
                     else:
                         rr.produced(1)
-            have = int(allreduce_tree(np.int64(blk is not None),
-                                      self.rt.mesh, "sum",
-                                      site="async_sgd/have"))
+            have = int(self._ctl(
+                # ps-engine: control exchange on the drain thread
+                lambda b=blk: allreduce_tree(np.int64(b is not None),
+                                             self.rt.mesh, "sum",
+                                             site="async_sgd/have")))
             if have == 0:
                 # global decision: status and the pool (hence any_claimed)
                 # are identical on every replica. A pending finished_id
@@ -1391,9 +1517,11 @@ class AsyncSGD:
             # drained hosts stay needy: a straggler re-issue must find a
             # claimant (drained flips back off when the pool hands work)
             need = my_it is None
-            status = allgather_tree(
-                rr.status_row(finished_id, need, drained),
-                self.rt.mesh, site="async_sgd/status")
+            status = self._ctl(
+                # ps-engine: control exchange on the drain thread
+                lambda: allgather_tree(
+                    rr.status_row(finished_id, need, drained),
+                    self.rt.mesh, site="async_sgd/status"))
             finished_id = -1
             rr.advance(status)
             for r in range(world):
@@ -1425,8 +1553,11 @@ class AsyncSGD:
                     drained = False
                     my_it = feed_iter(my_wl, my_skip)
                     collect(group)   # contribute in the claim round too
-            have = int(allreduce_tree(np.int64(len(group)), self.rt.mesh,
-                                      "sum", site="async_sgd/have"))
+            have = int(self._ctl(
+                # ps-engine: control exchange on the drain thread
+                lambda g=group: allreduce_tree(np.int64(len(g)),
+                                               self.rt.mesh, "sum",
+                                               site="async_sgd/have")))
             if have == 0:
                 # global decision: status and the pool (hence any_claimed)
                 # are identical on every replica
@@ -1504,8 +1635,19 @@ class AsyncSGD:
                     f"{cfg.data_format} multihost (whole blocks per "
                     "data index)")
         elif not (cfg.max_nnz and cfg.key_pad):
-            raise ValueError("multi-host sync training needs static "
-                             "max_nnz= and key_pad= config")
+            raise ValueError("multi-host sync training (and the ps "
+                             "engine path) needs static max_nnz= and "
+                             "key_pad= config")
+        self._engine = None
+        if cfg.staleness_tau >= 0:
+            from wormhole_tpu.ps import build_engine
+            # crec trains through device-level mesh steps (the model
+            # exchange is XLA's, not a host collective), so the engine
+            # there only owns the control-plane ordering; the sparse/
+            # text TRAIN pass routes its whole delta exchange through it
+            self._engine = build_engine(cfg, registry=self.obs.registry)
+            log.info("ps engine on: staleness_tau=%d window_steps=%d",
+                     cfg.staleness_tau, cfg.ps_window_steps)
         self._slot = self._host_slot()
         self._max_nnz = cfg.max_nnz
         ckpt = (ShardCheckpointer(cfg.checkpoint_dir)
@@ -1514,9 +1656,11 @@ class AsyncSGD:
         if ckpt is not None:
             # ranks must agree on the resume point even when the
             # checkpoint dir is not shared: the slowest view wins
-            ver = int(allreduce_tree(np.int64(ckpt.latest_version()),
-                                     self.rt.mesh, "min",
-                                     site="async_sgd/ckpt_ver"))
+            ver = int(self._ctl(
+                # ps-engine: control exchange on the drain thread
+                lambda: allreduce_tree(np.int64(ckpt.latest_version()),
+                                       self.rt.mesh, "min",
+                                       site="async_sgd/ckpt_ver")))
             if ver:
                 _, state = ckpt.load(self.store.state_pytree(),
                                      version=ver)
@@ -1534,66 +1678,79 @@ class AsyncSGD:
         completed = start_pass
         drained = False
         try:
-            for data_pass in range(start_pass, cfg.max_data_pass):
-                prog = (self._multihost_pass_crec(cfg.train_data, TRAIN)
-                        if crec
-                        else self._multihost_pass(cfg.train_data, TRAIN))
-                self.progress.merge(prog)
-                self._check_divergence(prog)
-                completed = data_pass + 1
-                if ckpt is not None \
-                        and completed % max(cfg.checkpoint_every, 1) == 0:
+            try:
+                for data_pass in range(start_pass, cfg.max_data_pass):
+                    prog = (self._multihost_pass_crec(cfg.train_data,
+                                                      TRAIN)
+                            if crec
+                            else self._multihost_pass_ps(cfg.train_data)
+                            if self._engine is not None
+                            else self._multihost_pass(cfg.train_data,
+                                                      TRAIN))
+                    self.progress.merge(prog)
+                    self._check_divergence(prog)
+                    completed = data_pass + 1
+                    if ckpt is not None \
+                            and completed % max(cfg.checkpoint_every,
+                                                1) == 0:
+                        self.ckpt_version = completed
+                        ckpt.save(completed, self.store.state_pytree())
+                        last_saved = completed
+                    if cfg.val_data:
+                        pooled: list = []
+                        vp = (self._multihost_pass_crec(cfg.val_data, VAL,
+                                                        pooled)
+                              if crec
+                              else self._multihost_pass(cfg.val_data, VAL,
+                                                        pooled))
+                        pass_auc = self._allreduce_pooled_auc(pooled)
+                        n = max(vp.num_ex, 1)
+                        log.info("pass %d validation: objv=%.6f auc=%.6f "
+                                 "acc=%.6f", data_pass, vp.objv / n,
+                                 pass_auc, vp.acc / max(vp.count, 1))
+                    # prog is GLOBAL (identical on all ranks), so every
+                    # rank takes the early-stop branch in the same pass
+                    if self._converged(data_pass, prog, prev_objv_ex):
+                        break
+                    prev_objv_ex = prog.objv / max(prog.num_ex, 1)
+            except ft_supervisor.DrainInterrupt:
+                # supervised SIGTERM (a peer is dead): commit a survivor
+                # checkpoint WITHOUT the cross-rank barrier — peers may
+                # be gone, and the resume-version allreduce-min is the
+                # real agreement (a version only wins when all
+                # relaunched ranks hold it). Version `completed` is
+                # re-committed with the freshest block-boundary state;
+                # its marker already exists, so an interrupted drain
+                # leaves the old commit intact.
+                drained = True
+                log.info("drain requested: abandoning pass at a block "
+                         "boundary; committing survivor checkpoint v%d",
+                         completed)
+                if ckpt is not None and completed:
                     self.ckpt_version = completed
-                    ckpt.save(completed, self.store.state_pytree())
+                    ckpt.save(completed, self.store.state_pytree(),
+                              barrier=False)
                     last_saved = completed
-                if cfg.val_data:
-                    pooled: list = []
-                    vp = (self._multihost_pass_crec(cfg.val_data, VAL,
-                                                    pooled)
-                          if crec
-                          else self._multihost_pass(cfg.val_data, VAL,
-                                                    pooled))
-                    pass_auc = self._allreduce_pooled_auc(pooled)
-                    n = max(vp.num_ex, 1)
-                    log.info("pass %d validation: objv=%.6f auc=%.6f "
-                             "acc=%.6f", data_pass, vp.objv / n, pass_auc,
-                             vp.acc / max(vp.count, 1))
-                # prog is GLOBAL (identical on all ranks), so every rank
-                # takes the early-stop branch in the same pass
-                if self._converged(data_pass, prog, prev_objv_ex):
-                    break
-                prev_objv_ex = prog.objv / max(prog.num_ex, 1)
-        except ft_supervisor.DrainInterrupt:
-            # supervised SIGTERM (a peer is dead): commit a survivor
-            # checkpoint WITHOUT the cross-rank barrier — peers may be
-            # gone, and the resume-version allreduce-min is the real
-            # agreement (a version only wins when all relaunched ranks
-            # hold it). Version `completed` is re-committed with the
-            # freshest block-boundary state; its marker already exists,
-            # so an interrupted drain leaves the old commit intact.
-            drained = True
-            log.info("drain requested: abandoning pass at a block "
-                     "boundary; committing survivor checkpoint v%d",
-                     completed)
-            if ckpt is not None and completed:
+            if ckpt is not None and last_saved < completed:
+                # the final pass must never be lost to checkpoint_every
+                # misalignment or an epsilon early stop
                 self.ckpt_version = completed
-                ckpt.save(completed, self.store.state_pytree(),
-                          barrier=False)
-                last_saved = completed
-        if ckpt is not None and last_saved < completed:
-            # the final pass must never be lost to checkpoint_every
-            # misalignment or an epsilon early stop
-            self.ckpt_version = completed
-            ckpt.save(completed, self.store.state_pytree())
-        if cfg.test_data and not drained:
-            pooled = []
-            if crec:
-                self._multihost_pass_crec(cfg.test_data, TEST, pooled)
-            else:
-                self._multihost_pass(cfg.test_data, TEST, pooled)
-            self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
-        if cfg.model_out and not drained:
-            self._store_io("save", cfg.model_out)
+                ckpt.save(completed, self.store.state_pytree())
+            if cfg.test_data and not drained:
+                pooled = []
+                if crec:
+                    self._multihost_pass_crec(cfg.test_data, TEST, pooled)
+                else:
+                    self._multihost_pass(cfg.test_data, TEST, pooled)
+                self._write_preds(pooled, f"{cfg.pred_out}_{self.rt.rank}")
+            if cfg.model_out and not drained:
+                self._store_io("save", cfg.model_out)
+        finally:
+            # the drain thread must not outlive the pass structure it
+            # serializes (a later run would race two engines)
+            if self._engine is not None:
+                self._engine.stop()
+                self._engine = None
         if self.timer.totals:
             log.info("pipeline profile:\n%s", self.timer.report())
         if self.obs.active:
@@ -1623,8 +1780,10 @@ class AsyncSGD:
         z = self.cfg.msg_compression
         # one tree, one exchange — and each leaf keeps its own
         # error-feedback residual slot at the site
-        pos, neg = allreduce_tree((pos, neg), self.rt.mesh, "sum",
-                                  compress=z, site="async_sgd/auc_hist")
+        pos, neg = self._ctl(
+            # ps-engine: control exchange on the drain thread
+            lambda: allreduce_tree((pos, neg), self.rt.mesh, "sum",
+                                   compress=z, site="async_sgd/auc_hist"))
         return auc_from_hist(np.asarray(pos), np.asarray(neg))
 
     def _write_preds(self, pooled: list, out_path: str) -> None:

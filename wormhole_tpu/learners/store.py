@@ -486,9 +486,8 @@ class ShardedStore(TableCheckpoint):
         if fn is not None:
             return fn
         exact_dense = zero_grad_push_is_identity(self.handle)
-        from jax import shard_map
         from wormhole_tpu.ops.metrics import margin_hist
-        from wormhole_tpu.parallel.mesh import DATA_AXIS
+        from wormhole_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         mesh = self.rt.mesh
         m = self.rt.model_axis_size
@@ -558,8 +557,8 @@ class ShardedStore(TableCheckpoint):
                 return body(s, packed_l, jnp.float32(0), jnp.float32(0),
                             jnp.float32(0))
         step = jax.jit(
-            shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False),
+            shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs),
             donate_argnums=(0, 2, 4) if kind == "train" else ())
         if not hasattr(self, "_dense_cache"):
             self._dense_cache = {}
@@ -677,10 +676,9 @@ class ShardedStore(TableCheckpoint):
         if fn is not None:
             return fn
         exact_dense = zero_grad_push_is_identity(self.handle)
-        from jax import shard_map
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
-        from wormhole_tpu.parallel.mesh import DATA_AXIS
+        from wormhole_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         mesh = self.rt.mesh
         spec = info.spec
@@ -752,8 +750,8 @@ class ShardedStore(TableCheckpoint):
                             jnp.float32(0), jnp.float32(0),
                             jnp.float32(0))
         step = jax.jit(
-            shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False),
+            shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs),
             # donate slots/clock/accumulator only when the step returns
             # them (train); the eval step has no aliasable output, so
             # donating would leave self.slots at a donated buffer
@@ -856,6 +854,39 @@ class ShardedStore(TableCheckpoint):
         self.slots = self._dt2[1](
             self.slots, batch.uniq_keys, batch.key_mask, grad, snap)
         self.t += 1
+
+    # -- dense global-delta apply (ps engine path) --------------------------
+    #
+    # The exchange engine ships gradient windows in dense bucket space:
+    # every host scatters its per-uniq-key gradient into a num_buckets
+    # vector, the engine allreduces it, and this push applies the summed
+    # window to the WHOLE replicated table. Same masking contract as the
+    # dense streaming steps (zero_grad_push_is_identity): exact handles
+    # sweep unmasked, the rest keep old slots where the global grad is
+    # exactly zero. ``tau`` is the engine-measured window delay — the DT
+    # handles' staleness input, scaled by lr_theta like every other path.
+
+    def _build_ps_push(self):
+        handle = self.handle
+        exact_dense = zero_grad_push_is_identity(handle)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def push(slots, grad, t, tau):
+            s32 = slots.astype(jnp.float32)
+            new = masked_push(handle, s32, grad, t.astype(jnp.float32),
+                              tau, exact_dense)
+            return new.astype(slots.dtype), t + 1
+
+        return push
+
+    def ps_push(self, grad, tau: float = 0.0) -> None:
+        """Apply one globally-reduced dense delta window (ps/ engine)."""
+        if not hasattr(self, "_ps_push_fn"):
+            self._ps_push_fn = self._build_ps_push()
+        self.slots, t_new = self._ps_push_fn(
+            self.slots, jnp.asarray(grad, jnp.float32),
+            self._t_device(), self._tau_const(tau))
+        self._advance_t(t_new)
 
     # -- the ZPush/ZPull surface --------------------------------------------
 

@@ -268,10 +268,10 @@ class FMStore(TableCheckpoint):
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
             return fn
-        from jax import shard_map
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import accuracy, margin_hist
-        from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from wormhole_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                                shard_map_compat)
         cfg = self.cfg
         k = cfg.dim
         objv_fn, dual_fn = self.objv_fn, self.dual_fn
@@ -369,8 +369,8 @@ class FMStore(TableCheckpoint):
                 return body(s, pw_, lab_, ovb_, ovr_, jnp.float32(0),
                             jnp.float32(0), jnp.float32(0))
         step = jax.jit(
-            shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False),
+            shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs),
             donate_argnums=(0, 5, 7) if kind == "train" else ())
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}

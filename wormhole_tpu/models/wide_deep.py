@@ -289,10 +289,10 @@ class WideDeepStore(TableCheckpoint):
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
             return fn
-        from jax import shard_map
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
-        from wormhole_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from wormhole_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                                shard_map_compat)
         cfg = self.cfg
         k = cfg.dim
         n_layers = self.n_layers
@@ -399,8 +399,8 @@ class WideDeepStore(TableCheckpoint):
                             jnp.float32(0))
             out_specs = (P(), P(), P(), P(), P(), P(DATA_AXIS))
         step = jax.jit(
-            shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False),
+            shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs),
             donate_argnums=(0, 1, 2, 7, 9) if kind == "train" else ())
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}

@@ -94,6 +94,13 @@ SPAN_TABLE: Dict[str, str] = {
     # the snapshot hot-swap is a reference assignment outside any step
     "serve:forward": "device_compute",
     "serve:swap": "other",
+    # bounded-staleness exchange engine (ps/): the drain thread's
+    # exchange span never lands in the step-loop ledger (wrong thread)
+    # but must still resolve; the gate is the trainer actually blocked
+    # on the wire, and the delta apply is a device push
+    "ps:exchange": "collective_wait",
+    "ps:gate": "collective_wait",
+    "ps:apply": "device_compute",
 }
 
 # DeviceFeed stage -> bucket, for dynamic ``<feed>:<stage>`` span names

@@ -76,6 +76,7 @@ DEFAULT_LOSSY_SITES: Set[str] = {
     "gbdt/level_hist",    # models/gbdt.py: per-level grad/hess hists
     "async_sgd/auc_hist", # learners/async_sgd.py: pooled-AUC histograms
     "bench/grad_hist",    # bench.py comm_filters phase payload
+    "ps/delta",           # ps engine: dense bucket-space grad windows
 }
 
 _FLAG_QUANT = 1

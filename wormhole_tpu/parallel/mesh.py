@@ -52,6 +52,24 @@ def make_mesh(spec: str = "", devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(dev_array, names)
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across the JAX API move.
+
+    jax >= 0.6 exports it at top level taking ``check_vma``; the 0.4.x
+    line only ships ``jax.experimental.shard_map`` with the equivalent
+    knob spelled ``check_rep``. Both are disabled here for the same
+    reason: the step bodies mix per-shard and replicated outputs that
+    the static replication checker cannot prove."""
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+
+
 def ensure_platform() -> None:
     """Make the JAX_PLATFORMS env var authoritative.
 

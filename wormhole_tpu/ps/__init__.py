@@ -1,0 +1,17 @@
+"""wormhole_tpu.ps: bounded-staleness async parameter exchange.
+
+The parameter-server consistency model (SSP, bounded staleness) layered
+over the repo's existing collective transport: a single background
+thread drains delta-window exchanges while the training loop runs up to
+``staleness_tau`` windows ahead. See docs/async_ps.md for the model,
+the determinism invariants, and the knobs.
+"""
+
+from wormhole_tpu.ps.config import build_engine
+from wormhole_tpu.ps.delay import DelayTracker
+from wormhole_tpu.ps.engine import ExchangeEngine, Ticket
+from wormhole_tpu.ps.queue import QueueClosed, WindowQueue
+from wormhole_tpu.ps.telemetry import PsMetrics, ps_metrics
+
+__all__ = ["build_engine", "DelayTracker", "ExchangeEngine", "Ticket",
+           "QueueClosed", "WindowQueue", "PsMetrics", "ps_metrics"]

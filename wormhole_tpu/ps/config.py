@@ -1,0 +1,25 @@
+"""Config -> engine construction (the one place the knobs are read)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from wormhole_tpu.ps.engine import ExchangeEngine
+from wormhole_tpu.ps.telemetry import ps_metrics
+
+__all__ = ["build_engine"]
+
+
+def build_engine(cfg, registry=None) -> Optional[ExchangeEngine]:
+    """An :class:`ExchangeEngine` per ``cfg.staleness_tau``, or ``None``
+    when the knob is negative (engine off, direct BSP collectives)."""
+    if cfg.staleness_tau < 0:
+        return None
+    if cfg.ps_window_steps < 1:
+        raise ValueError(
+            f"ps_window_steps={cfg.ps_window_steps}: need >= 1 device "
+            "steps per exchanged delta window")
+    metrics = ps_metrics(registry) if registry is not None else None
+    return ExchangeEngine(cfg.staleness_tau,
+                          queue_depth=cfg.ps_queue_depth,
+                          metrics=metrics)

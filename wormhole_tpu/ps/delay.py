@@ -1,0 +1,73 @@
+"""Delay and overlap accounting for the bounded-staleness engine.
+
+Two ledgers, both consumed downstream:
+
+- **Per-window delay** — the delay-tolerant handles (DTSGDHandle and
+  friends, learners/handles.py) take the gradient's staleness ``tau``
+  as an input to the learning rate. The tracker measures it exactly:
+  window *k*'s delay is the number of delta windows applied to the
+  store between *k*'s gradient computation (its submit) and *k*'s own
+  apply. Under the engine's deterministic gate this is ``min(k, tau)``
+  — 0 while the pipeline fills, then the configured bound — but the
+  tracker measures rather than assumes, so quiesce-time applies and
+  future schedules stay correct.
+- **Overlap** — ``exchange_s`` accumulates engine-thread seconds spent
+  inside the collective; ``blocked_s`` accumulates trainer seconds
+  stalled waiting on it. Their ratio is the headline the subsystem
+  exists for: ``overlap_fraction() == 0`` is BSP (every exchange second
+  is a trainer-blocked second), ``1`` is full hiding.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["DelayTracker"]
+
+
+class DelayTracker:
+    """Counts windows submitted/applied; attributes delay and overlap."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.submitted = 0   # delta windows handed to the engine
+        self.applied = 0     # delta windows pushed into the store
+        self.last_delay = 0  # delay of the most recently applied window
+        self.max_delay = 0
+        self.exchange_s = 0.0  # engine-thread seconds inside exchanges
+        self.blocked_s = 0.0   # trainer seconds stalled on the gate
+
+    def on_submit(self) -> int:
+        """Register a new delta window; returns the store step count at
+        gradient-computation time (the ``t0`` its delay is measured
+        against)."""
+        with self._lock:
+            self.submitted += 1
+            return self.applied
+
+    def on_apply(self, t0: int) -> int:
+        """Register window apply; returns its measured delay (windows
+        applied between its gradient computation and now)."""
+        with self._lock:
+            delay = self.applied - t0
+            self.applied += 1
+            self.last_delay = delay
+            if delay > self.max_delay:
+                self.max_delay = delay
+            return delay
+
+    def on_exchange(self, seconds: float) -> None:
+        with self._lock:
+            self.exchange_s += seconds
+
+    def on_blocked(self, seconds: float) -> None:
+        with self._lock:
+            self.blocked_s += seconds
+
+    def overlap_fraction(self) -> float:
+        """Fraction of exchange time hidden behind trainer compute."""
+        with self._lock:
+            if self.exchange_s <= 0.0:
+                return 0.0
+            f = 1.0 - self.blocked_s / self.exchange_s
+            return min(1.0, max(0.0, f))

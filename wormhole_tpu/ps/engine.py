@@ -1,0 +1,205 @@
+"""Bounded-staleness exchange engine: overlap DCN exchange with compute.
+
+The multihost BSP passes pay the cross-host allreduce on the training
+thread: every gradient window blocks until the wire round-trip is done.
+This engine moves the exchange onto one background thread and lets the
+trainer run up to ``staleness_tau`` windows ahead before blocking — the
+parameter-server consistency model (SSP) on top of the repo's existing
+collective transport.
+
+Correctness rests on two invariants:
+
+1. **One global collective order.** JAX multi-controller collectives
+   match across processes by issue order; two threads issuing
+   collectives concurrently can interleave differently on different
+   ranks and deadlock. In engine mode therefore EVERY host collective
+   of the training pass — delta windows *and* control-plane exchanges —
+   runs on this single drain thread, in submission order, and the
+   submission order is the same deterministic program order on every
+   rank.
+2. **Deterministic consumption.** The staleness gate collects completed
+   windows by *count* (oldest first, until at most ``tau`` remain in
+   flight), never by completion timing. Every rank therefore applies
+   the same windows at the same loop points and terminates after the
+   same number of submissions — termination can depend on exchanged
+   results without ranks drifting apart. At ``tau=0`` the gate
+   degenerates to submit-then-wait: the engine path is bit-identical
+   to the direct BSP collective (the parity oracle the tests pin).
+
+The transport is a closure per ticket: the engine never imports the
+collectives, so unit tests and the bench inject fake transports, while
+the real caller closes over ``allreduce_tree(..., site="ps/delta")`` —
+keeping chaos injection, the watchdog guard (armed on THIS thread; see
+ft/watchdog.py's per-thread slots) and the filter chain's wire-byte
+accounting exactly where they already live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+from wormhole_tpu.obs import trace
+from wormhole_tpu.ps.delay import DelayTracker
+from wormhole_tpu.ps.queue import WindowQueue
+
+__all__ = ["Ticket", "ExchangeEngine"]
+
+
+class Ticket:
+    """One exchange in flight: closure, result slot, completion event."""
+
+    __slots__ = ("fn", "kind", "index", "t0", "result", "error", "_done")
+
+    def __init__(self, fn: Callable[[], Any], kind: str, index: int,
+                 t0: int = 0) -> None:
+        self.fn = fn
+        self.kind = kind        # "delta" | "control"
+        self.index = index      # submission index within its kind
+        self.t0 = t0            # store step count at gradient compute
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class ExchangeEngine:
+    """One drain thread executing exchange tickets in submission order.
+
+    API (all trainer-thread; the deque of in-flight delta tickets is
+    touched by the trainer only, so no lock guards it):
+
+    - :meth:`submit` — enqueue a delta-window exchange; returns its
+      ticket without waiting.
+    - :meth:`gate` — pop completed delta tickets oldest-first until at
+      most ``tau`` remain in flight (blocking as needed); the caller
+      applies them in the returned order.
+    - :meth:`exchange` — run a control-plane exchange through the same
+      thread and wait for its result. FIFO means every earlier delta
+      has finished when this returns, but their tickets stay queued
+      for the next :meth:`gate`/:meth:`quiesce` — control reads never
+      swallow windows the trainer still has to apply.
+    - :meth:`quiesce` — wait out and return ALL in-flight deltas
+      (end of pass, drain-to-checkpoint).
+    - :meth:`stop` — close the queue and join the thread.
+    """
+
+    def __init__(self, staleness_tau: int, queue_depth: int = 0,
+                 metrics=None) -> None:
+        if staleness_tau < 0:
+            raise ValueError(f"staleness_tau={staleness_tau} < 0: "
+                             "negative tau means 'engine off'; build "
+                             "no engine instead")
+        self.tau = int(staleness_tau)
+        bound = int(queue_depth) if queue_depth > 0 else self.tau + 1
+        # +1 headroom: a control ticket may queue behind tau deltas
+        self._q = WindowQueue(bound + 1)
+        self._pending: deque = deque()  # delta tickets, submission order
+        self._metrics = metrics
+        self.delays = DelayTracker()
+        self._n_delta = 0
+        self._n_control = 0
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ps-exchange")
+        self._thread.start()
+
+    # -- drain thread ------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            t = self._q.get()
+            if t is None:
+                return
+            start = time.monotonic()
+            with trace.span("ps:exchange", cat="ps",
+                            args={"kind": t.kind, "idx": t.index}):
+                try:
+                    t.result = t.fn()
+                except BaseException as e:  # surfaced on the trainer
+                    t.error = e
+            dt = time.monotonic() - start
+            if t.kind == "delta":
+                self.delays.on_exchange(dt)
+                if self._metrics is not None:
+                    self._metrics.windows.inc()
+                    self._metrics.exchange_s.inc(dt)
+            t._done.set()
+
+    # -- trainer thread ----------------------------------------------
+
+    def submit(self, fn: Callable[[], Any]) -> Ticket:
+        """Enqueue one delta-window exchange; returns immediately."""
+        if self._stopped:
+            raise RuntimeError("exchange engine stopped")
+        t = Ticket(fn, "delta", self._n_delta, t0=self.delays.on_submit())
+        self._n_delta += 1
+        self._pending.append(t)
+        if self._metrics is not None:
+            self._metrics.queue_depth.max(len(self._pending))
+        self._q.put(t)
+        return t
+
+    def exchange(self, fn: Callable[[], Any]) -> Any:
+        """Synchronous control-plane exchange through the drain thread."""
+        if self._stopped:
+            raise RuntimeError("exchange engine stopped")
+        t = Ticket(fn, "control", self._n_control)
+        self._n_control += 1
+        self._q.put(t)
+        self._wait(t)
+        if t.error is not None:
+            raise t.error
+        return t.result
+
+    def gate(self) -> List[Ticket]:
+        """Enforce the staleness bound: collect (blocking oldest-first)
+        until at most ``tau`` windows remain in flight."""
+        out: List[Ticket] = []
+        while len(self._pending) > self.tau:
+            out.append(self._collect_front())
+        return out
+
+    def quiesce(self) -> List[Ticket]:
+        """Collect every in-flight window (pass end / drain)."""
+        out: List[Ticket] = []
+        while self._pending:
+            out.append(self._collect_front())
+        return out
+
+    def note_applied(self, ticket: Ticket) -> int:
+        """Record that ``ticket``'s delta just hit the store; returns
+        its measured delay (the DT handles' ``tau`` input)."""
+        delay = self.delays.on_apply(ticket.t0)
+        if self._metrics is not None:
+            self._metrics.staleness.max(delay)
+            self._metrics.overlap_frac.set(self.delays.overlap_fraction())
+        return delay
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.close()
+        self._thread.join(timeout=30.0)
+
+    def _collect_front(self) -> Ticket:
+        t = self._pending.popleft()
+        self._wait(t)
+        if t.error is not None:
+            raise t.error
+        return t
+
+    def _wait(self, t: Ticket) -> None:
+        if t._done.is_set():
+            return
+        start = time.monotonic()
+        with trace.span("ps:gate", cat="ps",
+                        args={"kind": t.kind, "idx": t.index}):
+            t._done.wait()
+        dt = time.monotonic() - start
+        self.delays.on_blocked(dt)
+        if self._metrics is not None:
+            self._metrics.blocked_s.inc(dt)

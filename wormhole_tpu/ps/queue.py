@@ -1,0 +1,67 @@
+"""Bounded window queue: the trainer->exchange-thread handoff.
+
+One producer (the training loop) hands closed-over collective calls to
+one consumer (the engine's drain thread). The bound is back-pressure,
+not correctness: the staleness gate in the engine already limits how
+far the trainer runs ahead, so a full queue only ever means the gate
+was configured looser than the queue — blocking the producer there
+keeps memory bounded without reordering anything.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Optional
+
+__all__ = ["QueueClosed", "WindowQueue"]
+
+
+class QueueClosed(RuntimeError):
+    """put() after close(): the engine is shutting down."""
+
+
+class WindowQueue:
+    """Thread-safe bounded FIFO with a close handshake.
+
+    ``put`` blocks while full and raises :class:`QueueClosed` once the
+    queue is closed; ``get`` blocks while empty and returns ``None``
+    once the queue is closed *and* drained — the consumer's signal to
+    exit its loop without a sentinel object racing real items.
+    """
+
+    def __init__(self, bound: int) -> None:
+        self._bound = max(1, int(bound))
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def put(self, item: Any) -> None:
+        with self._cv:
+            while len(self._q) >= self._bound and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                raise QueueClosed("exchange queue closed")
+            self._q.append(item)
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next item in FIFO order; ``None`` when closed and empty."""
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    return None
+                if not self._cv.wait(timeout=timeout):
+                    return None
+            item = self._q.popleft()
+            self._cv.notify_all()
+            return item
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)

@@ -1,0 +1,38 @@
+"""Single declaration site for the exchange-engine metric names
+(the lint_knobs unique-name contract, same shape as serve_metrics)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+__all__ = ["PsMetrics", "ps_metrics"]
+
+
+class PsMetrics(NamedTuple):
+    staleness: object      # gauge: delay of the last applied window
+    queue_depth: object    # gauge: engine queue depth (max across run)
+    windows: object        # counter: delta windows exchanged
+    exchange_s: object     # counter: engine seconds inside exchanges
+    blocked_s: object      # counter: trainer seconds stalled on the gate
+    overlap_frac: object   # gauge: fraction of exchange time hidden
+
+
+def ps_metrics(reg) -> PsMetrics:
+    return PsMetrics(
+        reg.gauge("ps/staleness",
+                  help="measured delay (store updates) of the most "
+                       "recently applied delta window", agg="max"),
+        reg.gauge("ps/queue_depth",
+                  help="exchange-queue depth observed at submit time "
+                       "(max agg across the run)", agg="max"),
+        reg.counter("ps/windows",
+                    help="delta windows exchanged through the engine"),
+        reg.counter("ps/exchange_s",
+                    help="engine-thread seconds inside the delta "
+                         "exchange collective"),
+        reg.counter("ps/blocked_s",
+                    help="trainer seconds blocked on the staleness "
+                         "gate / control exchanges"),
+        reg.gauge("ps/overlap_frac",
+                  help="fraction of exchange time hidden behind local "
+                       "compute (1 - blocked_s/exchange_s)"))

@@ -82,6 +82,18 @@ class WorkloadPool:
         self._assigned.clear()
         self._done_ids.clear()
 
+    def take_static(self, world: int, rank: int) -> List[Workload]:
+        """Deterministic round-robin split of the (replicated) queue:
+        part i goes to rank ``i % world``; the queue empties. The ps
+        engine pass uses this instead of the dynamic claim protocol —
+        the per-round claim collective exists to absorb stragglers, and
+        bounded staleness already does that (a slow rank delays only
+        the windows it contributes to, not a lockstep round)."""
+        mine = [wl for i, wl in enumerate(self._queue)
+                if i % world == rank]
+        self._queue.clear()
+        return mine
+
     def get(self, worker: object) -> Optional[Workload]:
         """Assign the next part to ``worker``; when the queue is empty,
         consider re-issuing a straggler (workload_pool.h:98-167,169-190)."""

@@ -131,6 +131,20 @@ class Config:
     comm_filters: str = ""
     comm_quant_bits: int = 8          # FIXING_FLOAT code width, in [2, 16]
     comm_compress_min_bytes: int = 1024  # COMPRESSING skips smaller leaves
+    # --- bounded-staleness async exchange (wormhole_tpu/ps) ---
+    # staleness_tau routes the multihost training exchange through the
+    # ExchangeEngine's background thread (docs/async_ps.md): the train
+    # loop runs up to tau gradient windows ahead of the freshest
+    # globally-applied delta before blocking. -1 = engine off (the
+    # direct BSP collective path, the default); 0 = engine on but fully
+    # synchronous — bit-identical to BSP, the parity oracle; >= 1
+    # overlaps the DCN exchange with local compute, feeding the DT
+    # handles the measured per-window delay.
+    staleness_tau: int = -1
+    # device steps folded into one exchanged delta window (>= 1)
+    ps_window_steps: int = 1
+    # engine queue bound; 0 = derive from staleness_tau (tau + 1)
+    ps_queue_depth: int = 0
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100

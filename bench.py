@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import time
 from collections import deque
@@ -98,9 +99,10 @@ def make_sparse_batch(rng, num_buckets: int):
                        row_mask=row_mask, uniq_keys=uniq, key_mask=key_mask)
 
 
-def write_crec2(path: str, rows: int, rng) -> None:
+def write_crec2(path: str, rows: int, rng, subblocks: int = 12) -> None:
     from wormhole_tpu.data.crec import CRec2Writer
-    with CRec2Writer(path, nnz=CRITEO_NNZ, nb=NUM_BUCKETS) as w:
+    with CRec2Writer(path, nnz=CRITEO_NNZ, nb=NUM_BUCKETS,
+                     subblocks=subblocks) as w:
         chunk = 200_000
         done = 0
         while done < rows:
@@ -1335,6 +1337,209 @@ def bench_chaos() -> dict:
     return out
 
 
+MULTICHIP_ROWS = 163_840     # 10 blocks x 16384 rows (subblocks=2)
+MULTICHIP_WINDOW = 6.0       # timed window per (shape, mode) run
+
+
+def _mc_app(path: str, shape: str, n_dev: int):
+    """One app per mesh shape: both feed modes run on the SAME app so
+    the jitted mesh step (each store instance owns its jit closures)
+    compiles once per shape, not once per (shape, mode)."""
+    import jax
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    rt = MeshRuntime.create()
+    rt.mesh = make_mesh(shape, jax.devices()[:n_dev])
+    cfg = Config(train_data=path, data_format="crec2",
+                 num_buckets=NUM_BUCKETS, max_delay=MAX_DELAY,
+                 lr_eta=0.1, disp_itv=1e12)
+    cfg.lambda_ = [1.0, 0.1]
+    return AsyncSGD(cfg, rt)
+
+
+def _mc_timed(app, path: str, mode: str, mesh: bool) -> dict:
+    """One timed feed-mode segment on a warmed app: stream passes until
+    the window closes. The rate is rows/elapsed with the deferred-metric
+    flush and a forced D2H read inside the clock (same honesty rules as
+    the e2e phases); the mesh feed telemetry is read back as registry
+    deltas because the registry is process-global across segments."""
+    import jax
+    from wormhole_tpu.obs.metrics import mesh_feed_gauges
+    app.cfg.mesh_feed = mode
+    gauges = mesh_feed_gauges(app.obs.registry)
+    gauges[0].value = 0.0                # skew mean: set per process()
+    gauges[1].value = 0.0                # skew max (agg=max): reset
+    c0 = [g.value for g in gauges]       # counters: delta per segment
+    t0 = time.perf_counter()
+    rows = 0
+    passes = 0
+    while True:
+        prog = app.process(path, 0, 1)
+        rows += prog.num_ex
+        passes += 1
+        if passes >= 1 and (time.perf_counter() - t0 >= MULTICHIP_WINDOW
+                            or _deadline_passed()):
+            break
+    rows += app.flush_metrics().num_ex
+    jax.block_until_ready(app.store.slots)
+    float(np.asarray(app.store.slots[0, 0]))
+    rec = {"ex_per_sec": rows / (time.perf_counter() - t0),
+           "passes": passes}
+    if mesh:
+        rec.update({
+            "dispatch_skew_ms": round(gauges[0].value, 3),
+            "dispatch_skew_ms_max": round(gauges[1].value, 3),
+            "feed_groups": int(gauges[2].value - c0[2]),
+            "pad_blocks": int(gauges[3].value - c0[3]),
+            "spill_blocks": int(gauges[4].value - c0[4]),
+        })
+    wire = app.obs.registry.get("comm/bytes_wire")
+    rec["comm_bytes_wire"] = int(wire.value) if wire else 0
+    return rec
+
+
+def _mc_warm(app, path: str) -> None:
+    import jax
+    app.process(path, 0, 1)              # compile + ramp
+    jax.block_until_ready(app.store.slots)
+    float(np.asarray(app.store.slots[0, 0]))
+    app.flush_metrics()
+
+
+def _bench_multichip_inline() -> dict:
+    """Mesh scale-out sweep over the local devices: for each mesh shape
+    (pure data-parallel, then data x model splits) run BOTH feed modes —
+    ``ring`` (sharded DeviceFeed: prep workers stack the D-group off the
+    dispatch thread, the transfer ring device_puts it onto its
+    (data, model) NamedSharding so H2D overlaps the mesh step) and
+    ``sync`` (the pre-scale-out stack-in-loop baseline) — over the SAME
+    crec2 rows. Reports per-shape ex/s for both modes, ring/sync,
+    speedup and scaling efficiency vs a single-chip anchor (the
+    single-device process() path on devices[0]), per-group dispatch-skew
+    straggler telemetry, and comm/bytes_wire (0 in single-process runs
+    — reported, not invented). The file uses subblocks=2 blocks (16384
+    rows) so a D-wide group is a fine dispatch unit, and is sized so a
+    full single-device pass fits the window even on a core-starved fake
+    CPU mesh (each fake device gets a slice of the host). On a fake CPU
+    mesh the devices
+    share host cores, so scaling_efficiency ~ 1/n is expected — the
+    gates in scripts/bench_check.py are calibrated against the measured
+    trajectory, not an ideal-scaling fantasy."""
+    import jax
+    n = len(jax.devices())
+    workdir = tempfile.mkdtemp(prefix="wh_bench_mc_")
+    path = os.path.join(workdir, "mc.crec2")
+    rng = np.random.default_rng(7)
+    write_crec2(path, MULTICHIP_ROWS, rng, subblocks=2)
+    out = {"n_devices": n, "rows": MULTICHIP_ROWS,
+           "window_sec": MULTICHIP_WINDOW}
+    try:
+        app0 = _mc_app(path, "data:1", 1)
+        _mc_warm(app0, path)
+        anchor = _mc_timed(app0, path, "ring", mesh=False)
+        del app0
+        rate0 = anchor["ex_per_sec"]
+        out["anchor_ex_per_sec"] = round(rate0, 1)
+        out["anchor_passes"] = anchor["passes"]
+        print(f"[bench] multichip anchor data:1 {rate0:,.0f} ex/s",
+              file=sys.stderr, flush=True)
+        shapes = [(f"data:{n}", n)]
+        if n >= 4 and n % 2 == 0:
+            shapes.append((f"data:{n // 2},model:2", n))
+        if n >= 8 and n % 4 == 0:
+            shapes.append((f"data:{n // 4},model:4", n))
+        out["shapes"] = {}
+        for shape, nd in shapes:
+            if _deadline_passed():
+                out["budget_truncated"] = True
+                break
+            # Both feed modes run on ONE app (same jit closures): the
+            # shape compiles once, the modes differ only host-side.
+            app = _mc_app(path, shape, nd)
+            _mc_warm(app, path)
+            ring = _mc_timed(app, path, "ring", mesh=True)
+            sync = _mc_timed(app, path, "sync", mesh=True)
+            del app
+            print(f"[bench] multichip {shape} ring "
+                  f"{ring['ex_per_sec']:,.0f} sync "
+                  f"{sync['ex_per_sec']:,.0f} ex/s",
+                  file=sys.stderr, flush=True)
+            rec = {"ring_ex_per_sec": round(ring["ex_per_sec"], 1),
+                   "sync_ex_per_sec": round(sync["ex_per_sec"], 1),
+                   "ring_vs_sync": round(
+                       ring["ex_per_sec"] / max(sync["ex_per_sec"],
+                                                1e-9), 3),
+                   "speedup_vs_anchor": round(
+                       ring["ex_per_sec"] / max(rate0, 1e-9), 3),
+                   "scaling_efficiency": round(
+                       ring["ex_per_sec"] / max(rate0 * nd, 1e-9), 4)}
+            for k in ("passes", "dispatch_skew_ms", "dispatch_skew_ms_max",
+                      "feed_groups", "pad_blocks", "spill_blocks",
+                      "comm_bytes_wire"):
+                rec[k] = ring[k]
+            out["shapes"][shape] = rec
+    finally:
+        try:
+            os.remove(path)
+            os.rmdir(workdir)
+        except OSError:
+            pass
+    return out
+
+
+def bench_multichip() -> dict:
+    """Sharded multichip scale-out (tentpole of the mesh-feed PR): runs
+    the shape x feed-mode sweep inline when this process already sees
+    >= 2 devices; on a single-device box (the usual CPU test host) it
+    re-execs ``bench.py --phases multichip`` in a subprocess with XLA's
+    forced 8-device host platform, so the mesh feed, NamedSharding
+    device_put and shard_map step actually span devices instead of
+    degenerating to the single-chip path."""
+    import jax
+    if len(jax.devices()) >= 2:
+        return _bench_multichip_inline()
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="wh_bench_mc_sub_")
+    out_path = os.path.join(workdir, "mc.json")
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    remaining = (_DEADLINE - time.perf_counter()) if _DEADLINE > 0 else 0.0
+    budget = max(120.0, remaining) if remaining > 0 else 600.0
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--phases", "multichip", "--out", out_path,
+         "--budget", str(round(budget, 1)), "--no-telemetry"],
+        capture_output=True, text=True, cwd=repo, env=env,
+        timeout=budget + 120.0)
+    try:
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"multichip subprocess rc={r.returncode}: "
+                f"{(r.stderr or r.stdout)[-800:]}")
+        with open(out_path) as f:
+            inner = json.load(f)
+        failed = inner.get("extra", {}).get("phases_failed", {})
+        if "multichip" in failed:
+            raise RuntimeError(
+                f"multichip subprocess phase failed: {failed['multichip']}")
+        rec = inner["extra"]["multichip"]
+    finally:
+        try:
+            os.remove(out_path)
+            os.rmdir(workdir)
+        except OSError:
+            pass
+    rec["via"] = "subprocess: --xla_force_host_platform_device_count=8 (cpu)"
+    return rec
+
+
 # ordered phase registry; headline phases first so a tight budget still
 # produces the metric. Phases needing the shared tile stores / the crec2
 # file / the text file are tagged so a filtered run only builds what it
@@ -1342,8 +1547,8 @@ def bench_chaos() -> dict:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "device_sparse", "device_dense_apply",
-          "scale_curve", "serve", "comm_filters", "async_ps", "kmeans",
-          "lbfgs", "gbdt", "chaos"]
+          "scale_curve", "multichip", "serve", "comm_filters",
+          "async_ps", "kmeans", "lbfgs", "gbdt", "chaos"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1457,6 +1662,8 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
         if name in results:
             extra[key] = {k: (round(v, 4) if isinstance(v, float) else v)
                           for k, v in results[name].items()}
+    if "multichip" in results:
+        extra["multichip"] = results["multichip"]
     if "e2e_stream" in results:
         stream = results["e2e_stream"]
         extra["e2e_stream_noncached"] = {
@@ -1576,6 +1783,7 @@ def main(argv=None) -> None:
         "device_sparse": bench_device_sparse,
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
+        "multichip": bench_multichip,
         "serve": bench_serve,
         "comm_filters": bench_comm_filters,
         "async_ps": bench_async_ps,

@@ -28,10 +28,25 @@ regresses against its predecessor:
   same path — growth there means wall time leaked out of the accounted
   buckets.
 
+The ``MULTICHIP_r*.json`` trajectory (``bench.py --phases multichip``
+snapshots: per-mesh-shape ring/sync/anchor ex/s plus scaling
+efficiency) is gated with the same machinery, plus two multichip-only
+rules:
+
+- **Scaling trend**: every numeric ``*scaling_efficiency`` key shared
+  between consecutive usable runs is higher-is-better under ``--tol``,
+  exactly like a throughput key.
+- **Scaling floor**: the NEWEST usable run's ``*scaling_efficiency``
+  values must each clear ``--min-scaling`` (absolute). The default is
+  calibrated to the measured CPU fake-mesh trajectory, where all
+  "devices" share the host cores so efficiency sits near ``1/n`` — a
+  real multi-chip host clears it by an order of magnitude.
+
 Runs that did not produce a result (``parsed`` null or ``rc != 0`` —
-e.g. r05's rc=124 timeout) are skipped with a note: a crashed run is the
-roadmap's problem, not a throughput regression, and must not poison the
-comparison chain.
+e.g. r05's rc=124 timeout, or the early MULTICHIP dryrun snapshots that
+carry no ``parsed`` block at all) are skipped with a note: a crashed
+run is the roadmap's problem, not a throughput regression, and must not
+poison the comparison chain.
 
 Usage::
 
@@ -55,13 +70,22 @@ _RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
 # gated above, and double-gating one measurement would double the noise
 # exposure.
 _LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
+_SCALE_PAT = re.compile(r"scaling_efficiency$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
+# default --min-scaling: the measured CPU fake-8-device trajectory sits
+# at 0.09-0.13 across the swept shapes (all "devices" share the host
+# cores, so ~1/n is the honest ceiling); 0.05 passes that with headroom
+# while catching a mesh feed that serializes outright (efficiency ->
+# 1/n^2 territory)
+_MIN_SCALING = 0.05
 
 
-def load_runs(bench_dir: str) -> List[Tuple[str, Optional[dict]]]:
+def load_runs(bench_dir: str,
+              prefix: str = "BENCH") -> List[Tuple[str, Optional[dict]]]:
     """[(run_name, parsed-or-None)] in run order; None = skipped run."""
     out: List[Tuple[str, Optional[dict]]] = []
-    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+    for path in sorted(glob.glob(
+            os.path.join(bench_dir, f"{prefix}_r*.json"))):
         name = os.path.splitext(os.path.basename(path))[0]
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -116,6 +140,11 @@ def rate_keys(parsed: dict) -> Dict[str, float]:
 def latency_keys(parsed: dict) -> Dict[str, float]:
     """Tail-latency keys (LOWER is better) under ``parsed``."""
     return _keys_matching(parsed, _LAT_PAT)
+
+
+def scaling_keys(parsed: dict) -> Dict[str, float]:
+    """Multichip ``*scaling_efficiency`` keys (higher is better)."""
+    return _keys_matching(parsed, _SCALE_PAT)
 
 
 def ledger_fracs(parsed: dict) -> Dict[str, float]:
@@ -175,6 +204,16 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
                 f"{key}: {cv:.1f}ms > {pv:.1f}ms * {1 + tol:.2f} "
                 f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
                 "serve tail latency regression")
+    pscale, cscale = scaling_keys(prev), scaling_keys(cur)
+    for key in sorted(set(pscale) & set(cscale)):
+        pv, cv = pscale[key], cscale[key]
+        if pv <= 0:
+            continue
+        if cv < pv * (1.0 - tol):
+            bad.append(
+                f"{key}: {cv:.4f} < {pv:.4f} * {1 - tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
+                "multichip scaling efficiency regression")
     pfracs, cfracs = ledger_fracs(prev), ledger_fracs(cur)
     for key in sorted(set(pfracs) & set(cfracs)):
         if cfracs[key] > pfracs[key] + tol_frac:
@@ -185,29 +224,60 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
     return bad
 
 
-def run(bench_dir: str, tol: float, tol_frac: float,
-        all_pairs: bool = False) -> int:
-    runs = [(n, p) for n, p in load_runs(bench_dir) if p is not None]
-    if len(runs) < 2:
-        print(f"bench_check: {len(runs)} usable run(s) under "
-              f"{bench_dir!r}; nothing to gate")
-        return 0
-    pairs = list(zip(runs, runs[1:])) if all_pairs else [runs[-2:]]
+def scaling_floor(name: str, parsed: dict,
+                  min_scaling: float) -> List[str]:
+    """Absolute floor on the newest multichip run's scaling efficiency:
+    trend gating alone would wave through a trajectory that decays
+    within tolerance every round."""
+    return [
+        f"{key}: {v:.4f} < --min-scaling {min_scaling:.4f} ({name}) — "
+        "multichip scaling efficiency below the absolute floor"
+        for key, v in sorted(scaling_keys(parsed).items())
+        if v < min_scaling]
+
+
+def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
+                     tol_frac: float, all_pairs: bool,
+                     min_scaling: float) -> Tuple[List[str], int, int]:
+    """(failures, pairs_compared, keys_compared) for one run prefix."""
+    runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
+            if p is not None]
     failures: List[str] = []
+    if prefix == "MULTICHIP" and runs:
+        failures.extend(scaling_floor(*runs[-1], min_scaling))
+    if len(runs) < 2:
+        print(f"bench_check: {len(runs)} usable {prefix} run(s) under "
+              f"{bench_dir!r}; nothing to gate pairwise")
+        return failures, 0, 0
+    pairs = list(zip(runs, runs[1:])) if all_pairs else [runs[-2:]]
     compared = 0
     for (pn, pp), (cn, cp) in pairs:
         compared += len(set(rate_keys(pp)) & set(rate_keys(cp)))
         compared += len(set(latency_keys(pp)) & set(latency_keys(cp)))
+        compared += len(set(scaling_keys(pp)) & set(scaling_keys(cp)))
         failures.extend(compare(pn, pp, cn, cp, tol, tol_frac))
+    return failures, len(pairs), compared
+
+
+def run(bench_dir: str, tol: float, tol_frac: float,
+        all_pairs: bool = False, min_scaling: float = _MIN_SCALING) -> int:
+    failures: List[str] = []
+    pairs = compared = 0
+    for prefix in ("BENCH", "MULTICHIP"):
+        f, p, c = _gate_trajectory(prefix, bench_dir, tol, tol_frac,
+                                   all_pairs, min_scaling)
+        failures.extend(f)
+        pairs += p
+        compared += c
     if failures:
         print(f"bench_check: {len(failures)} regression(s):",
               file=sys.stderr)
         for msg in failures:
             print(f"  {msg}", file=sys.stderr)
         return 1
-    print(f"bench_check: OK ({len(pairs)} pair(s), {compared} shared "
-          f"throughput/latency keys, tol {tol:.0%}, ledger tol "
-          f"+{tol_frac:.2f})")
+    print(f"bench_check: OK ({pairs} pair(s), {compared} shared "
+          f"throughput/latency/scaling keys, tol {tol:.0%}, ledger tol "
+          f"+{tol_frac:.2f}, scaling floor {min_scaling})")
     return 0
 
 
@@ -223,11 +293,17 @@ def main(argv=None) -> int:
                     help="absolute growth tolerated in the ledger "
                          "unattributed/residual_stall fractions "
                          "(default 0.10)")
+    ap.add_argument("--min-scaling", type=float, default=_MIN_SCALING,
+                    help="absolute floor on the newest MULTICHIP run's "
+                         "*scaling_efficiency values (default "
+                         f"{_MIN_SCALING}; the CPU fake-mesh trajectory "
+                         "measures ~1/n_devices)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
     args = ap.parse_args(argv)
-    return run(args.dir, args.tol, args.tol_frac, all_pairs=args.all_pairs)
+    return run(args.dir, args.tol, args.tol_frac,
+               all_pairs=args.all_pairs, min_scaling=args.min_scaling)
 
 
 if __name__ == "__main__":

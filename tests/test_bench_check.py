@@ -213,3 +213,72 @@ def test_attempts_list_gates_latest_only(tmp_path):
     assert r.returncode == 1
     assert "chaos_recovery.shrink.attempts.latest.ex_per_sec" \
         in r.stderr, r.stderr
+
+
+def _write_mc(d, n, parsed, rc=0):
+    doc = {"n": n, "cmd": "bench --phases multichip", "rc": rc,
+           "tail": "", "parsed": parsed}
+    with open(os.path.join(d, f"MULTICHIP_r{n:02d}.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def _mc_parsed(ring, sync, anchor=100_000.0, eff=None, n_dev=8):
+    eff = ring / (anchor * n_dev) if eff is None else eff
+    return {"n_devices": n_dev, "anchor_ex_per_sec": anchor,
+            "shapes": {f"data:{n_dev}": {
+                "ring_ex_per_sec": ring, "sync_ex_per_sec": sync,
+                "ring_vs_sync": ring / sync,
+                "speedup_vs_anchor": ring / anchor,
+                "scaling_efficiency": eff}}}
+
+
+def test_multichip_scaling_floor_gates_newest_run(tmp_path):
+    # a single usable MULTICHIP run is enough for the absolute floor
+    d = str(tmp_path)
+    _write_mc(d, 1, _mc_parsed(120_000.0, 100_000.0, eff=0.01))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "scaling_efficiency" in r.stderr and "floor" in r.stderr
+    # clearing the floor (default 0.05) passes; a raised floor fails
+    _write_mc(d, 1, _mc_parsed(120_000.0, 100_000.0, eff=0.12))
+    assert _run("--dir", d).returncode == 0
+    assert _run("--dir", d, "--min-scaling", "0.5").returncode == 1
+
+
+def test_multichip_rate_regression_fails(tmp_path):
+    d = str(tmp_path)
+    _write_mc(d, 1, _mc_parsed(120_000.0, 100_000.0, eff=0.12))
+    _write_mc(d, 2, _mc_parsed(55_000.0, 100_000.0, eff=0.12))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "ring_ex_per_sec" in r.stderr
+    # within tolerance: fine (and the BENCH trajectory stays vacuous)
+    _write_mc(d, 2, _mc_parsed(110_000.0, 95_000.0, eff=0.11))
+    assert _run("--dir", d).returncode == 0
+
+
+def test_multichip_scaling_trend_regression_fails(tmp_path):
+    # rates hold but efficiency collapses (anchor got faster): gated
+    d = str(tmp_path)
+    _write_mc(d, 1, _mc_parsed(120_000.0, 100_000.0, eff=0.40))
+    _write_mc(d, 2, _mc_parsed(120_000.0, 100_000.0, eff=0.10))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "scaling efficiency regression" in r.stderr
+
+
+def test_multichip_dryrun_snapshots_skipped_and_bridge(tmp_path):
+    """The early MULTICHIP_r01..05 snapshots carry no ``parsed`` block
+    (dryrun-era wrappers): skipped with a note, and the comparison
+    chain bridges across them."""
+    d = str(tmp_path)
+    with open(os.path.join(d, "MULTICHIP_r01.json"), "w") as f:
+        json.dump({"n_devices": 8, "rc": 0, "ok": True, "tail": "x"}, f)
+    _write_mc(d, 2, _mc_parsed(100_000.0, 90_000.0, eff=0.12))
+    _write_mc(d, 3, _mc_parsed(98_000.0, 91_000.0, eff=0.12))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MULTICHIP_r01" in r.stdout and "skipped" in r.stdout
+    # a drop across the bridge still fails
+    _write_mc(d, 3, _mc_parsed(40_000.0, 91_000.0, eff=0.12))
+    assert _run("--dir", d).returncode == 1

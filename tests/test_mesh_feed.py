@@ -1,0 +1,165 @@
+"""Sharded multichip feed (data/crec.MeshGroupFeed + cfg.mesh_feed).
+
+The scale-out PR moves the mesh dispatch loop's group stacking onto the
+feed's prep workers and its H2D onto the transfer ring (device_put onto
+the (data, model) NamedSharding). Three contracts pinned here:
+
+  * worker/mode determinism — the pipelined ring (workers=N) is
+    bit-identical to the serial inline feed (workers=0), and the ring
+    path trains the same table as the legacy synchronous
+    stack-in-the-loop dispatch (``mesh_feed=sync``): same groups, same
+    padding, same step order — only WHERE the stack/transfer happen
+    moves;
+  * short-tail PAD parity — an eval pass whose tail group is mostly
+    PAD filler blocks pools exactly the same (margin, label) rows as
+    the single-device path over the same file: PAD lanes (label 255)
+    are invisible, and the pooled labels come from the stacked group
+    views, not a per-dispatch host concatenate;
+  * spill accounting — an online-encoded block whose COO overflow
+    exceeds the cap rides the SAME ring as the groups (passthrough, no
+    group flush) to the audited scatter step: every row credited once,
+    and the mesh/spill_blocks + feed/tile_fallback_blocks counters
+    tick.
+"""
+
+import jax
+import numpy as np
+
+from wormhole_tpu.data.crec import CRec2Writer, CRecWriter
+from wormhole_tpu.ops import tilemm
+from wormhole_tpu.sched.workload_pool import VAL
+
+NB = 2 * tilemm.TILE
+NNZ = 8
+BR = tilemm.RSUB          # subblocks=1: one RSUB-row block per group slot
+
+
+def make_rows(rng, n, planted=True):
+    keys = rng.integers(0, 1 << 32, size=(n, NNZ), dtype=np.uint32)
+    keys[keys == 0xFFFFFFFF] = 0
+    keys[rng.random((n, NNZ)) < 0.1] = 0xFFFFFFFF
+    if planted:
+        sel = rng.random(n) < 0.5
+        keys[sel, 0] = np.uint32(123456)
+        keys[~sel, 0] = np.uint32(654321)
+        labels = sel.astype(np.uint8)
+    else:
+        labels = (rng.random(n) < 0.4).astype(np.uint8)
+    return keys, labels
+
+
+def write_file(path, keys, labels):
+    with CRec2Writer(str(path), nnz=NNZ, nb=NB, subblocks=1,
+                     ovf_cap=4096) as w:
+        w.append(keys, labels)
+
+
+def make_app(path, mesh_spec, fmt="crec2", **over):
+    from wormhole_tpu.learners.async_sgd import AsyncSGD
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+    from wormhole_tpu.utils.config import Config
+    kw = dict(train_data=str(path), data_format=fmt, num_buckets=NB,
+              lr_eta=0.5, max_data_pass=1, disp_itv=1e12, max_delay=1)
+    kw.update(over)
+    rt = MeshRuntime.create()
+    n_dev = int(np.prod([int(p.split(":")[1])
+                         for p in mesh_spec.split(",")]))
+    rt.mesh = make_mesh(mesh_spec, jax.devices()[:n_dev])
+    return AsyncSGD(Config(**kw), rt)
+
+
+def test_ring_workers_and_sync_mode_bit_identical(tmp_path, rng):
+    """data:8 over 11 blocks (one full group + a 3-block padded tail):
+    the pipelined ring, the serial ring (workers=0, the inline oracle)
+    and the synchronous legacy dispatch all produce the SAME slots,
+    bit for bit, and credit every row."""
+    n = 10 * BR + 4000
+    keys, labels = make_rows(rng, n)
+    path = tmp_path / "det.crec2"
+    write_file(path, keys, labels)
+
+    def train(mode, workers):
+        app = make_app(path, "data:8", mesh_feed=mode,
+                       pipeline_workers=workers)
+        prog = app.run()
+        assert prog.num_ex == n, (mode, workers)
+        return np.asarray(app.store.slots)
+
+    ring2 = train("ring", 2)
+    ring0 = train("ring", 0)
+    sync = train("sync", 2)
+    assert np.array_equal(ring2, ring0)
+    assert np.array_equal(ring2, sync)
+
+
+def test_padded_tail_eval_pooled_matches_single_device(tmp_path, rng):
+    """Eval pooled output across a data:2 mesh whose last group is one
+    real block + one all-PAD filler equals the single-device pass over
+    the same file and weights: same margins, same labels, no phantom
+    rows from the PAD lanes."""
+    n = 2 * BR + 1000                       # 3 blocks -> tail group pads
+    keys, labels = make_rows(rng, n)
+    path = tmp_path / "tail.crec2"
+    write_file(path, keys, labels)
+
+    ref = make_app(path, "data:1")
+    ref.run()                               # train once for nonzero margins
+    host_slots = np.asarray(ref.store.slots)
+
+    def eval_pooled(app):
+        app.store.slots = jax.numpy.asarray(host_slots)
+        pooled = []
+        prog = app.process(str(path), 0, 1, kind=VAL, pooled=pooled)
+        m = np.concatenate([p[0] for p in pooled])
+        y = np.concatenate([p[1] for p in pooled])
+        return prog, m, y
+
+    prog1, m1, y1 = eval_pooled(make_app(path, "data:1"))
+    prog2, m2, y2 = eval_pooled(make_app(path, "data:2"))
+    assert prog1.num_ex == n and prog2.num_ex == n
+    assert y1.shape == (n,) and y2.shape == (n,)
+    assert np.array_equal(y1, y2)
+    assert np.array_equal(y1, np.minimum(labels, 1).astype(np.float32))
+    assert np.allclose(m1, m2, rtol=1e-4, atol=1e-5)
+    assert np.isclose(prog1.objv, prog2.objv, rtol=1e-4)
+
+
+def test_online_spill_blocks_ride_the_ring(tmp_path, rng):
+    """tile_online over a v1 stream on a data:2 mesh: a hot-bucket block
+    (overflow past the cap) falls back to the scatter step THROUGH the
+    ring as a passthrough spill — it must not flush the open group, the
+    spill counters tick, every row is credited once, and the pipelined
+    ring matches the workers=0 oracle bit for bit."""
+    from wormhole_tpu.obs.metrics import default_registry, mesh_feed_gauges
+    blocks = []
+    lab = []
+    for i in range(4):
+        k, l = make_rows(rng, BR)
+        if i == 2:                          # the spill block: one hot bucket
+            k = np.full((BR, NNZ), np.uint32(42), np.uint32)
+        blocks.append(k)
+        lab.append(l)
+    keys = np.concatenate(blocks)
+    labels = np.concatenate(lab)
+    n = len(labels)
+    path = tmp_path / "spill.crec"
+    with CRecWriter(str(path), nnz=NNZ, block_rows=BR) as w:
+        w.append(keys, labels)
+
+    reg = default_registry()
+    fallback = reg.counter("feed/tile_fallback_blocks")
+
+    def train(workers):
+        gauges = mesh_feed_gauges(reg)
+        spills0, fb0 = gauges[4].value, fallback.value
+        app = make_app(path, "data:2", fmt="crec", tile_online="on",
+                       mesh_feed="ring", pipeline_workers=workers)
+        prog = app.run()
+        assert prog.num_ex == n, workers
+        assert gauges[4].value == spills0 + 1.0    # mesh/spill_blocks
+        assert fallback.value == fb0 + 1.0
+        return np.asarray(app.store.slots)
+
+    w2 = train(2)
+    w0 = train(0)
+    assert np.array_equal(w2, w0)

@@ -974,3 +974,212 @@ class TileOnlineFeed:
             for k in ("prep_stall", "encode_stall", "consume_stall"):
                 timer.add(prefix + k, out[k], n)
         return out
+
+
+# ---------------------------------------------------------------------------
+# sharded multi-device group feed: stack + pre-place data-axis groups on
+# the pipeline workers so the mesh step never waits on host copies
+# ---------------------------------------------------------------------------
+
+
+def mesh_pads(info, is_tile: bool):
+    """The shared all-PAD block used to fill a short tail group — built
+    once per part, never per dispatch (the pad arrays are megabytes).
+    Tile pads are PADWORD pair words + 255 labels + empty overflow; v1
+    pads are one all-0xFF buffer (sentinel keys AND pad labels are
+    0xFF). Read-only by contract: every padded group shares them."""
+    if is_tile:
+        from wormhole_tpu.ops.tilemm import PADWORD
+        spec = info.spec
+        return {
+            "pw": np.full(spec.pairs_shape, PADWORD, np.uint32),
+            "labels": np.full(info.block_rows, PAD_LABEL, np.uint8),
+            "ovf_b": np.full(max(info.ovf_cap, 1), 0xFFFFFFFF, np.uint32),
+            "ovf_r": np.zeros(max(info.ovf_cap, 1), np.uint32),
+        }
+    return np.full(info.block_bytes, 0xFF, np.uint8)
+
+
+def stack_mesh_group(views: list, D: int, info, pads, is_tile: bool,
+                     want_labels: bool = False):
+    """Stack one data-axis group of host blocks into the mesh step's
+    stacked operands, padding a short group to ``D`` with ``pads``
+    (:func:`mesh_pads`). Returns ``(blocks, labels_u8)`` where
+    ``labels_u8`` — only materialized when ``want_labels`` (eval
+    pooling) — is a flat view of the ALREADY-stacked label lanes, not a
+    per-block concatenate: the global (D*R,) row order matches the mesh
+    eval step's margin output, PAD rows carried as 255."""
+    if len(views) < D:
+        views = views + [pads] * (D - len(views))
+    if is_tile:
+        blocks = {
+            "pw": np.stack([v["pw"] for v in views]),
+            "labels": np.stack([v["labels"] for v in views]),
+            "ovf_b": np.stack([v.get("ovf_b", pads["ovf_b"])
+                               for v in views]),
+            "ovf_r": np.stack([v.get("ovf_r", pads["ovf_r"])
+                               for v in views]),
+        }
+        labels = blocks["labels"].reshape(-1) if want_labels else None
+        return blocks, labels
+    blocks = np.stack(views)
+    labels = None
+    if want_labels:
+        lab_off = info.block_rows * info.nnz * 4
+        labels = (blocks[:, lab_off:lab_off + info.block_rows]
+                  .reshape(-1))
+    return blocks, labels
+
+
+class MeshGroupFeed:
+    """Sharded DeviceFeed for the multi-device crec/crec2 path: the
+    mesh counterpart of PackedFeed/TileOnlineFeed.
+
+    The pre-scale-out mesh loop stacked D host blocks with ``np.stack``
+    on the dispatch thread and let jit transfer the group synchronously
+    — the exact host work the single-device path moved onto the PR 1
+    pipeline long ago. This feed restores the split: the DeviceFeed
+    dispatcher forms data-axis groups in stream order
+    (``pipeline.group_blocks``, recording per-group arrival skew — the
+    straggler telemetry), the prep workers stack + pad each group
+    (:func:`stack_mesh_group`), and the transfer thread ``device_put``s
+    the stacked operands directly onto their (data, model)
+    NamedSharding (``learners.store.mesh_group_shardings``) so the H2D
+    copy overlaps the previous group's mesh step and the step consumes
+    pre-placed arrays with zero re-layout.
+
+    Encode-overflow spill batches (online mode: the inner TileOnlineFeed
+    yields a SparseBatch for a block whose COO overflow exceeds the cap)
+    ride the SAME ring as ``("spill", batch_dev, labels_u8, rows)``
+    items — in stream position, without flushing the open group — so a
+    skewed block no longer stalls the group loop for a synchronous
+    scatter round trip.
+
+    Yields ``("group", blocks_dev, labels_u8, rows)`` and
+    ``("spill", batch_dev, labels_u8, rows)``; ``labels_u8`` is None
+    unless ``want_labels``. ``workers=0`` runs every stage inline on
+    the consumer thread — the bit-determinism oracle, same contract as
+    DeviceFeed."""
+
+    def __init__(self, inner, D: int, shardings, info, is_tile: bool, *,
+                 workers: int = 2, depth: int = 2, online: bool = False,
+                 want_labels: bool = False, name: str = "meshfeed"):
+        self.inner = inner
+        self.D = D
+        self.info = info
+        self.is_tile = is_tile
+        self.online = online
+        self.want_labels = want_labels
+        self.workers = workers
+        self.depth = depth
+        self.name = name
+        self._shardings = shardings
+        self._pads = mesh_pads(info, is_tile)
+        self.put_time = 0.0
+        # dispatcher-thread counters (single writer; consumers read via
+        # skew_snapshot after iteration)
+        self.skew = {"groups": 0, "skew_sum": 0.0, "skew_max": 0.0,
+                     "pad_blocks": 0, "spill_blocks": 0}
+        self._pipe = None
+
+    @property
+    def bytes_read(self) -> int:
+        return self.inner.bytes_read
+
+    def skew_snapshot(self) -> dict:
+        return dict(self.skew)
+
+    def _source(self):
+        from wormhole_tpu.data.pipeline import group_blocks
+
+        def is_spill(item) -> bool:
+            # online inner feeds yield a SparseBatch (not a typed block
+            # dict) for cap-overflow blocks; v1/crec2 streams never spill
+            return self.online and not isinstance(item[0], dict)
+
+        sk = self.skew
+        for tag, payload, skew_s in group_blocks(
+                self.inner, self.D, passthrough=is_spill):
+            if tag == "item":
+                dev, host, rows = payload
+                sk["spill_blocks"] += 1
+                yield ("spill", dev, np.asarray(host), rows)
+                continue
+            sk["groups"] += 1
+            sk["skew_sum"] += skew_s
+            sk["skew_max"] = max(sk["skew_max"], skew_s)
+            sk["pad_blocks"] += self.D - len(payload)
+            yield ("group", [p[0] for p in payload],
+                   sum(p[2] for p in payload))
+
+    def _assemble(self, item, _ctx):
+        """Worker-side stage: pad + stack one group (the host copy the
+        old loop paid on the dispatch thread)."""
+        if item[0] == "spill":
+            return item
+        _tag, views, rows = item
+        blocks, labels = stack_mesh_group(views, self.D, self.info,
+                                          self._pads, self.is_tile,
+                                          self.want_labels)
+        return ("group", blocks, labels, rows)
+
+    def _transfer(self, item):
+        import time as _time
+        import jax
+        t0 = _time.perf_counter()
+        if item[0] == "spill":
+            _tag, batch, lab, rows = item
+            dev = jax.device_put(batch)
+            self.put_time += _time.perf_counter() - t0
+            return ("spill", dev, lab, rows)
+        _tag, blocks, labels, rows = item
+        dev = jax.device_put(blocks, self._shardings)
+        self.put_time += _time.perf_counter() - t0
+        return ("group", dev, labels, rows)
+
+    def __iter__(self):
+        from wormhole_tpu.data.pipeline import DeviceFeed
+        feed = DeviceFeed(self._source(), self._assemble,
+                          workers=self.workers, ring_depth=self.depth,
+                          transfer=self._transfer, name=self.name,
+                          prep_label="stack")
+        self._pipe = feed
+        yield from feed
+
+    def drain_pipe_stats(self, timer, prefix: str = "") -> Optional[dict]:
+        """Merged two-layer snapshot in PackedFeed's key scheme plus the
+        stack stage: ``prep``/``parse`` stay the inner feed's read and
+        assembly work, ``stack``/``stack_stall`` are the group-assembly
+        pool's busy seconds and the in-order transfer wait on it, and
+        ``put`` is this feed's sharded device_put seconds (the inner
+        feed runs an identity put). An inner ``encode`` stage (online
+        tile encoding) passes through."""
+        inner_snap = (self.inner.drain_pipe_stats(None)
+                      if hasattr(self.inner, "drain_pipe_stats") else None)
+        pipe, self._pipe = self._pipe, None
+        snap = pipe.drain_stats(None) if pipe is not None else None
+        if snap is None:
+            return inner_snap
+        inner_snap = inner_snap or {}
+        out = {
+            "parse": inner_snap.get("parse", 0.0),
+            "prep": inner_snap.get("prep", 0.0),
+            "prep_stall": inner_snap.get("prep_stall", 0.0),
+            "put": snap["put"],
+            "put_stall": inner_snap.get("put_stall", 0.0),
+            "stack": snap["prep"],
+            "stack_stall": snap["put_stall"],
+            "consume_stall": snap["consume_stall"],
+            "batches": snap["batches"],
+            "ring_max": snap["ring_max"],
+        }
+        if "encode" in inner_snap:
+            out["encode"] = inner_snap["encode"]
+            out["encode_stall"] = inner_snap["encode_stall"]
+        if timer is not None:
+            n = max(out["batches"], 1)
+            for k in ("parse", "put", "stack"):
+                timer.add(prefix + k, out[k], n)
+            for k in ("prep_stall", "stack_stall", "consume_stall"):
+                timer.add(prefix + k, out[k], n)
+        return out

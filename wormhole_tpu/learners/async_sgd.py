@@ -378,6 +378,13 @@ class AsyncSGD:
             self.timer.add(pfx + "encode_stall", snap["encode_stall"], n)
             stall_c, _ = obs.metrics.encode_counters(self.obs.registry)
             stall_c.inc(snap["encode_stall"])
+        if "stack" in snap:
+            # mesh group-assembly stage (data/crec.MeshGroupFeed):
+            # stack_stall is the in-order transferrer waiting on the
+            # group-stack workers — the "is group assembly the
+            # bottleneck?" signal for the sharded mesh feed
+            self.timer.add(pfx + "stack", snap["stack"], n)
+            self.timer.add(pfx + "stack_stall", snap["stack_stall"], n)
         self.feed_stats["feed_stall"] += snap["consume_stall"]
         self.feed_stats["feed_batches"] += snap["batches"]
         self.feed_stats["ring_max"] = max(self.feed_stats["ring_max"],
@@ -797,11 +804,28 @@ class AsyncSGD:
         tile step (model axis shards bucket tiles), crec v1 the mesh
         dense-apply step (model axis range-shards the folded table); data
         axis shards blocks either way. ``online`` routes a v1/text stream
-        through the online tile encoder (same typed blocks as crec2);
-        encode-overflow blocks arrive as SparseBatch and run through the
-        scatter step synchronously, outside the D-grouping."""
-        from wormhole_tpu.data.crec import PackedFeed
+        through the online tile encoder (same typed blocks as crec2).
+
+        Two feed modes (cfg.mesh_feed):
+
+        - ``ring`` — the sharded DeviceFeed path
+          (data/crec.MeshGroupFeed): prep workers pad+stack each D-group
+          off the dispatch thread, the transfer ring ``device_put``s it
+          onto its (data, model) NamedSharding so H2D overlaps the mesh
+          step, and encode-overflow spill batches ride the same ring in
+          stream position;
+        - ``sync`` — the pre-scale-out loop (stack on the dispatch
+          thread, jit-time transfer, synchronous spill scatter), kept as
+          the measured baseline for ``bench.py --phases multichip``.
+
+        Either way spill/eval metrics are folded from batched device
+        fetches, and eval pooling reuses the stacked label lanes instead
+        of re-concatenating per-block labels per group."""
+        from wormhole_tpu.data.crec import (MeshGroupFeed, mesh_pads,
+                                            stack_mesh_group)
+        from wormhole_tpu.learners.store import mesh_group_shardings
         from wormhole_tpu.ops.metrics import auc_from_hist
+        from wormhole_tpu.utils.config import check_choice
         if jax.process_count() > 1:
             # unreachable from run() (run_multihost handles crec/crec2
             # via _multihost_pass_crec); direct process() callers must go
@@ -809,135 +833,182 @@ class AsyncSGD:
             raise RuntimeError(
                 f"call run()/run_multihost for multi-process {fmt} — "
                 "process() is single-process only")
+        check_choice("mesh_feed", self.cfg.mesh_feed, ("ring", "sync"))
+        use_ring = self.cfg.mesh_feed == "ring"
         is_tile = fmt == "crec2" or online
         D = self.rt.data_axis_size
         pfx = "" if kind == TRAIN else "eval_"
-        # no-op device_put: the mesh step jits host arrays straight onto
-        # their (data, model)-sharded layout
-        feed = self._make_feed(file, part, nparts, fmt,
-                               device_put=lambda x: x,
-                               tile_info=info if online else None)
-        group: list = []
-
-        # shared pad arrays — building them per dispatch would allocate
-        # megabytes of throwaway uint16 per step in the hot loop
-        if is_tile:
-            spec = info.spec
-            ovf_pad_b = np.full(max(info.ovf_cap, 1), 0xFFFFFFFF,
-                                np.uint32)
-            ovf_pad_r = np.zeros(max(info.ovf_cap, 1), np.uint32)
-            pw_pad = np.full(spec.pairs_shape, PADWORD, np.uint32)
-            lab_pad = np.full(info.block_rows, 255, np.uint8)
-
-            def pad_block():
-                return {"pw": pw_pad, "labels": lab_pad,
-                        "ovf_b": ovf_pad_b, "ovf_r": ovf_pad_r}
-        else:
-            # one all-0xFF buffer: sentinel keys AND pad labels are 0xFF
-            v1_pad = np.full(info.block_bytes, 0xFF, np.uint8)
-
-            def pad_block():
-                return v1_pad
+        want_labels = kind != TRAIN and pooled is not None
 
         nsteps = [0]         # train steps since the last accumulator fetch
         hist_tot = [np.zeros(512), np.zeros(512)]
+        # deferred metric windows: eval steps and overflow-fallback
+        # scatter steps batch their device fetches (a per-step
+        # float(np.asarray(...)) forces a full round trip each and
+        # serializes the async dispatch pipeline)
+        eval_pending: list = []
+        spill_pending: list = []
+
+        def drain_spill() -> None:
+            """Resolve overflow-fallback steps: sparse-path metric tuple
+            layout — [objv, num_ex, auc, acc, wdelta2|margin]."""
+            if not spill_pending:
+                return
+            fetched = jax.device_get([s[0] for s in spill_pending])
+            for (_m, labels_u8), metrics in zip(spill_pending, fetched):
+                local.objv += float(metrics[0])
+                local.num_ex += int(metrics[1])
+                local.count += 1
+                local.auc += float(metrics[2])
+                local.acc += float(metrics[3])
+                if kind == TRAIN:
+                    local.wdelta2 += float(metrics[4])
+                elif pooled is not None and labels_u8 is not None:
+                    margin = np.asarray(metrics[4])
+                    real = labels_u8 != 255
+                    pooled.append((margin[real],
+                                   np.minimum(labels_u8[real], 1)
+                                   .astype(np.float32),
+                                   np.ones(int(real.sum()), np.float32)))
+            spill_pending.clear()
+
+        def drain_eval() -> None:
+            """Resolve grouped mesh eval steps: [objv_g, tot_ex,
+            acc_frac, pos, neg, margin] with the margin global over the
+            (D*R,) stacked row order — exactly the label-lane order
+            ``stack_mesh_group`` recorded."""
+            if not eval_pending:
+                return
+            fetched = jax.device_get([p[0] for p in eval_pending])
+            for (_m, labels_u8), m in zip(eval_pending, fetched):
+                local.objv += float(m[0])
+                local.num_ex += int(m[1])
+                local.count += 1
+                local.acc += float(m[2])
+                local.auc += auc_from_hist(m[3], m[4])
+                if pooled is not None and labels_u8 is not None:
+                    margins = np.asarray(m[5])
+                    real = labels_u8 != 255
+                    pooled.append((margins[real],
+                                   np.minimum(labels_u8[real], 1)
+                                   .astype(np.float32),
+                                   np.ones(int(real.sum()), np.float32)))
+            eval_pending.clear()
 
         def drain_pending(final: bool = True) -> None:
-            """Harvest the on-device accumulator via the async ticket
-            pipeline (mid-part windows are non-final so the device never
-            drains waiting on a metrics round trip)."""
-            self._harvest_macc(local, hist_tot, nsteps[0], final)
-            nsteps[0] = 0
-
-        def dispatch(views_list):
-            while len(views_list) < D:
-                views_list.append(pad_block())
-            if is_tile:
-                blocks = {k: np.stack([v[k] for v in views_list])
-                          for k in ("pw", "labels")}
-                blocks["ovf_b"] = np.stack(
-                    [v.get("ovf_b", ovf_pad_b) for v in views_list])
-                blocks["ovf_r"] = np.stack(
-                    [v.get("ovf_r", ovf_pad_r) for v in views_list])
+            """Harvest everything outstanding: the on-device train
+            accumulator rides the async ticket pipeline (mid-part
+            windows are non-final so the device never drains waiting on
+            a metrics round trip); eval/spill windows batch-fetch."""
+            drain_spill()
+            if kind == TRAIN:
+                self._harvest_macc(local, hist_tot, nsteps[0], final)
+                nsteps[0] = 0
             else:
-                blocks = np.stack(views_list)
-            with self.timer.scope(pfx + "dispatch"):
-                if kind == TRAIN:
-                    if is_tile:
-                        self.store.tile_train_step_mesh(blocks, info)
-                    else:
-                        self.store.dense_train_step_mesh(
-                            blocks, info.block_rows, info.nnz)
-                    nsteps[0] += 1
-                    if (self.reporter.due()
-                            or nsteps[0] >= self.CREC_DRAIN_CHUNK):
-                        with self.timer.scope(pfx + "wait"):
-                            drain_pending(final=False)
-                else:
-                    m = (self.store.tile_eval_step_mesh(blocks, info)
-                         if is_tile else
-                         self.store.dense_eval_step_mesh(
-                             blocks, info.block_rows, info.nnz))
-                    local.objv += float(np.asarray(m[0]))
-                    local.num_ex += int(np.asarray(m[1]))
-                    local.count += 1
-                    local.acc += float(np.asarray(m[2]))
-                    local.auc += auc_from_hist(np.asarray(m[3]),
-                                               np.asarray(m[4]))
-                    if pooled is not None:
-                        margins = np.asarray(jax.device_get(m[5]))
-                        from wormhole_tpu.data.crec import unpack_block
-                        labs = np.concatenate(
-                            [v["labels"] if is_tile
-                             else unpack_block(v, info)[1]
-                             for v in views_list])
-                        real = labs != 255
-                        pooled.append(
-                            (margins[real],
-                             np.minimum(labs[real], 1).astype(np.float32),
-                             np.ones(int(real.sum()), np.float32)))
+                drain_eval()
 
-        def dispatch_spill(batch, labels_u8):
-            """Encode-overflow block: one synchronous scatter step (the
-            replicated-table sparse path) with its metrics folded into
-            ``local`` immediately — the on-device tile accumulator never
-            sees this block."""
+        def run_group(blocks, labels_u8) -> None:
+            with self.timer.scope(pfx + "dispatch"):
+                with obs.trace.span("mesh:dispatch", cat="mesh"):
+                    if kind == TRAIN:
+                        if is_tile:
+                            self.store.tile_train_step_mesh(blocks, info)
+                        else:
+                            self.store.dense_train_step_mesh(
+                                blocks, info.block_rows, info.nnz)
+                    else:
+                        m = (self.store.tile_eval_step_mesh(blocks, info)
+                             if is_tile else
+                             self.store.dense_eval_step_mesh(
+                                 blocks, info.block_rows, info.nnz))
+            if kind == TRAIN:
+                nsteps[0] += 1
+                if (self.reporter.due()
+                        or nsteps[0] >= self.CREC_DRAIN_CHUNK):
+                    with self.timer.scope(pfx + "wait"):
+                        drain_pending(final=False)
+            else:
+                eval_pending.append((m, labels_u8))
+                if (not use_ring
+                        or len(eval_pending) >= self.CREC_DRAIN_CHUNK):
+                    with self.timer.scope(pfx + "wait"):
+                        drain_eval()
+
+        def run_spill(batch, labels_u8) -> None:
+            """Encode-overflow block through the audited scatter step
+            (the replicated-table sparse path) — the on-device tile
+            accumulator never sees this block. ``ring`` mode defers the
+            metric fetch with the other spills; ``sync`` keeps the
+            legacy synchronous round trip."""
             obs.metrics.encode_counters(self.obs.registry)[1].inc(1)
             with self.timer.scope(pfx + "dispatch"):
-                m = (self.store.train_step(batch, tau=0.0)
-                     if kind == TRAIN else self.store.eval_step(batch))
-            metrics = jax.device_get(m)
-            local.objv += float(metrics[0])
-            local.num_ex += int(metrics[1])
-            local.count += 1
-            local.auc += float(metrics[2])
-            local.acc += float(metrics[3])
-            if kind == TRAIN:
-                local.wdelta2 += float(metrics[4])
-            elif pooled is not None and labels_u8 is not None:
-                margin = np.asarray(metrics[4])
-                real = labels_u8 != 255
-                pooled.append((margin[real],
-                               np.minimum(labels_u8[real], 1)
-                               .astype(np.float32),
-                               np.ones(int(real.sum()), np.float32)))
+                with obs.trace.span("mesh:spill", cat="mesh"):
+                    m = (self.store.train_step(batch, tau=0.0)
+                         if kind == TRAIN else self.store.eval_step(batch))
+            spill_pending.append((m, labels_u8))
+            if (not use_ring
+                    or len(spill_pending) >= self.CREC_DRAIN_CHUNK):
+                with self.timer.scope(pfx + "wait"):
+                    drain_spill()
 
-        for dev, host, _rows in feed:
-            if online and not isinstance(dev, dict):
-                # the online feed's host item is the labels-only array
-                dispatch_spill(dev, np.asarray(host))
-                continue
-            group.append(dev)
-            if len(group) == D:
-                dispatch(group)
-                group = []
-        if group:
-            dispatch(group)
+        inner = self._make_feed(file, part, nparts, fmt,
+                                device_put=lambda x: x,
+                                tile_info=info if online else None)
+        if use_ring:
+            feed = MeshGroupFeed(
+                inner, D, mesh_group_shardings(self.rt, is_tile), info,
+                is_tile, workers=self.cfg.pipeline_workers,
+                depth=max(self.cfg.pipeline_ring, 1), online=online,
+                want_labels=want_labels)
+            for tag, payload, labels_u8, _rows in feed:
+                if tag == "spill":
+                    run_spill(payload, labels_u8)
+                else:
+                    run_group(payload, labels_u8)
+        else:
+            feed = inner
+            pads = mesh_pads(info, is_tile)
+            group: list = []
+
+            def flush() -> None:
+                with obs.trace.span("mesh:stack", cat="mesh"):
+                    blocks, labels_u8 = stack_mesh_group(
+                        group, D, info, pads, is_tile, want_labels)
+                run_group(blocks, labels_u8)
+
+            for dev, host, _rows in feed:
+                if online and not isinstance(dev, dict):
+                    # the online feed's host item is the labels-only array
+                    run_spill(dev, np.asarray(host))
+                    continue
+                group.append(dev)
+                if len(group) == D:
+                    flush()
+                    group = []
+            if group:
+                flush()
         with self.timer.scope(pfx + "wait"):
             drain_pending()
         self.timer.add(pfx + "put", feed.put_time)
         self._merge_pipe_snap(feed.drain_pipe_stats(None), pfx, local)
+        if use_ring:
+            self._export_mesh_feed_stats(feed)
         return local
+
+    def _export_mesh_feed_stats(self, feed) -> None:
+        """Fold a MeshGroupFeed's dispatcher-side counters into the obs
+        registry (obs.metrics.mesh_feed_gauges): per-group arrival skew
+        — the per-device straggler signal the multichip bench reports —
+        plus group/pad/spill block counts."""
+        snap = feed.skew_snapshot()
+        g_skew, g_skew_max, c_groups, c_pads, c_spills = \
+            obs.metrics.mesh_feed_gauges(self.obs.registry)
+        if snap["groups"]:
+            g_skew.set(1e3 * snap["skew_sum"] / snap["groups"])
+        g_skew_max.max(1e3 * snap["skew_max"])
+        c_groups.inc(snap["groups"])
+        c_pads.inc(snap["pad_blocks"])
+        c_spills.inc(snap["spill_blocks"])
 
     @staticmethod
     def _real_rows(batch) -> np.ndarray:

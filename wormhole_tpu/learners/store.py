@@ -152,8 +152,7 @@ def mesh_tile_geometry(rt, spec):
         raise ValueError(f"nb {spec.nb} not shardable over model axis {m}")
     nb_local = spec.nb // m
     spec_local = tilemm.make_spec(nb_local, spec.subblocks, spec.cap)
-    have_model = m > 1 and MODEL_AXIS in rt.mesh.axis_names
-    return nb_local, spec_local, have_model
+    return nb_local, spec_local, rt.have_model
 
 
 def shard_range_mask(ovb, off, nb_local):
@@ -188,6 +187,53 @@ def mesh_macc_row(objv_g, tot_ex, acc_frac, wdelta2, pos_g, neg_g):
     (TableCheckpoint.MACC_LEN layout, consumed by _harvest_macc)."""
     return jnp.concatenate([
         jnp.stack([objv_g, tot_ex, acc_frac, wdelta2]), pos_g, neg_g])
+
+
+def mesh_step_specs(have_model):
+    """(Pm, Pblk, data_specs) shared by every stacked-group tile mesh
+    step (linear/FM/wide&deep): the slots-table spec, the (D,T,SG,N)
+    packed-word spec, and the full (slots, pw, labels, ovf_b, ovf_r)
+    in_specs prefix. One declaration keeps the three step builders and
+    :func:`mesh_group_shardings` (the feed's pre-placement layout) from
+    drifting apart."""
+    from wormhole_tpu.parallel.mesh import DATA_AXIS
+    Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+    Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
+            else P(DATA_AXIS, None, None, None))
+    data_specs = (Pm, Pblk, P(DATA_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS, None))
+    return Pm, Pblk, data_specs
+
+
+def mesh_group_shardings(rt: MeshRuntime, is_tile: bool):
+    """NamedSharding pytree for ONE stacked D-group, matching the mesh
+    steps' in_specs exactly — the layout the sharded feed
+    (data/crec.MeshGroupFeed) ``device_put``s onto, so a pre-placed
+    group enters shard_map with zero re-layout copies. Tile groups are
+    the {pw, labels, ovf_b, ovf_r} dict; v1 groups the stacked
+    (D, block_bytes) u8 array."""
+    from wormhole_tpu.parallel.mesh import DATA_AXIS
+    lane = rt.sharding(DATA_AXIS, None)
+    if not is_tile:
+        return lane
+    _Pm, Pblk, _ = mesh_step_specs(rt.have_model)
+    return {"pw": NamedSharding(rt.mesh, Pblk), "labels": lane,
+            "ovf_b": lane, "ovf_r": lane}
+
+
+def mesh_ovf_zeros(D: int, oc: int) -> np.ndarray:
+    """Cached all-zero (D, max(oc,1)) u32 overflow stand-in for blocks
+    without ovf arrays — allocating it per dispatch put a host memset in
+    the mesh hot loop. Callers must not mutate it."""
+    key = (D, oc)
+    buf = _OVF_ZEROS.get(key)
+    if buf is None:
+        buf = _OVF_ZEROS[key] = np.zeros((D, max(oc, 1)), np.uint32)
+        buf.setflags(write=False)
+    return buf
+
+
+_OVF_ZEROS: dict = {}
 
 
 @dataclass
@@ -496,7 +542,7 @@ class ShardedStore(TableCheckpoint):
             raise ValueError(f"num_buckets {nb} not shardable over "
                              f"model axis {m}")
         nb_local = nb // m
-        have_model = m > 1 and MODEL_AXIS in mesh.axis_names
+        have_model = self.rt.have_model
         R, N = block_rows, nnz
         nk = R * N * 4
 
@@ -544,7 +590,7 @@ class ShardedStore(TableCheckpoint):
                                      pos_g, neg_g)
             return new.astype(slots_l.dtype), t + 1, macc + packed_m
 
-        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
+        Pm, _Pblk, _ = mesh_step_specs(have_model)
         if kind == "train":
             in_specs = (Pm, P(DATA_AXIS, None), P(), P(), P())
             out_specs = (Pm, P(), P())
@@ -642,7 +688,13 @@ class ShardedStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new.astype(slots.dtype), t + 1, macc + packed
+                # num_ex rides along as the caller's completion ticket:
+                # unlike t+1/macc it never re-enters the donated step
+                # chain, so block_until_ready on it stays legal after
+                # later steps dispatch (donation is real on committed
+                # multi-device layouts, not just TPU)
+                return (new.astype(slots.dtype), t + 1, macc + packed,
+                        num_ex)
         else:
             @jax.jit
             def step(slots, block):
@@ -730,11 +782,7 @@ class ShardedStore(TableCheckpoint):
                                    pos_g, neg_g)
             return new.astype(slots_l.dtype), t + 1, macc + packed
 
-        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
-        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
-                else P(DATA_AXIS, None, None, None))
-        data_specs = (Pm, Pblk, P(DATA_AXIS, None),
-                      P(DATA_AXIS, None), P(DATA_AXIS, None))
+        Pm, _Pblk, data_specs = mesh_step_specs(have_model)
         if kind == "train":
             in_specs = data_specs + (P(), P(), P())
             out_specs = (Pm, P(), P())
@@ -770,7 +818,7 @@ class ShardedStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         self.slots, t_new, self._macc = step(
             self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
@@ -781,7 +829,7 @@ class ShardedStore(TableCheckpoint):
     def tile_eval_step_mesh(self, blocks: dict, info):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         return self._tile_step_mesh(info, "eval")(
             self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z))
@@ -789,14 +837,16 @@ class ShardedStore(TableCheckpoint):
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block step over a typed block dict (crec.block2_views
         shipped to device). Metrics accumulate ON DEVICE (fetch_metrics);
-        the returned device scalar (the step clock) exists only so callers
-        can gate the staleness window on real completion."""
+        the returned device scalar (this step's example count) exists
+        only so callers can gate the staleness window on real completion
+        — the clock itself is donated into the next step, so it is NOT
+        safe to block on."""
         step = self._tile_step(info, "train")
-        self.slots, t_new, self._macc = step(
+        self.slots, t_new, self._macc, ticket = step(
             self.slots, block, self._t_device(), self._tau_const(tau),
             self._macc_buf())
         self._advance_t(t_new)
-        return t_new
+        return ticket
 
     def tile_eval_step(self, block: dict, info):
         return self._tile_step(info, "eval")(self.slots, block)

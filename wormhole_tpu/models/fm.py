@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.learners.store import (TableCheckpoint,
+                                          mesh_ovf_zeros,
                                           shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
@@ -238,7 +239,10 @@ class FMStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
-                return new.astype(slots.dtype), t + 1, macc + packed
+                # num_ex = completion ticket; the clock/macc outputs are
+                # donated into the next step (see ShardedStore._tile_step)
+                return (new.astype(slots.dtype), t + 1, macc + packed,
+                        num_ex)
         else:
             @jax.jit
             def step(slots, block):
@@ -278,6 +282,7 @@ class FMStore(TableCheckpoint):
         penalty = L1L2(cfg.l1, cfg.l2)
         from wormhole_tpu.learners.store import (mesh_macc_row,
                                                  mesh_metric_sums,
+                                                 mesh_step_specs,
                                                  mesh_tile_geometry,
                                                  shard_range_mask)
         mesh = self.rt.mesh
@@ -352,11 +357,7 @@ class FMStore(TableCheckpoint):
             return new.astype(slots_l.dtype), t + 1, macc + packed
 
         from jax.sharding import PartitionSpec as P
-        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
-        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
-                else P(DATA_AXIS, None, None, None))
-        data_specs = (Pm, Pblk, P(DATA_AXIS, None),
-                      P(DATA_AXIS, None), P(DATA_AXIS, None))
+        Pm, _Pblk, data_specs = mesh_step_specs(have_model)
         if kind == "train":
             in_specs = data_specs + (P(), P(), P())
             out_specs = (Pm, P(), P())
@@ -383,7 +384,7 @@ class FMStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         self.slots, t_new, self._macc = step(
             self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
@@ -394,20 +395,21 @@ class FMStore(TableCheckpoint):
     def tile_eval_step_mesh(self, blocks: dict, info):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         return self._tile_step_mesh(info, "eval")(
             self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z))
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block FM step; metrics accumulate ON DEVICE
-        (fetch_metrics, same harvest pipeline as ShardedStore)."""
+        (fetch_metrics, same harvest pipeline as ShardedStore). Returns
+        the non-donated completion ticket, never the clock."""
         step = self._tile_step(info, "train")
-        self.slots, t_new, self._macc = step(
+        self.slots, t_new, self._macc, ticket = step(
             self.slots, block, self._t_device(), self._tau_const(tau),
             self._macc_buf())
         self._advance_t(t_new)
-        return t_new
+        return ticket
 
     def tile_eval_step(self, block: dict, info):
         return self._tile_step(info, "eval")(self.slots, block)

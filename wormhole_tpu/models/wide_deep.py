@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.learners.store import (TableCheckpoint,
+                                          mesh_ovf_zeros,
                                           shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
@@ -260,8 +261,10 @@ class WideDeepStore(TableCheckpoint):
                 packed = jnp.concatenate([
                     jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
                     pos, neg])
+                # num_ex = completion ticket; the clock/macc outputs are
+                # donated into the next step (see ShardedStore._tile_step)
                 return (new.astype(slots.dtype), mlp_new, accum, t + 1,
-                        macc + packed)
+                        macc + packed, num_ex)
         else:
             @jax.jit
             def step(slots, mlp, block):
@@ -380,9 +383,8 @@ class WideDeepStore(TableCheckpoint):
                     macc + packed)
 
         from jax.sharding import PartitionSpec as P
-        Pm = P(MODEL_AXIS, None) if have_model else P(None, None)
-        Pblk = (P(DATA_AXIS, MODEL_AXIS, None, None) if have_model
-                else P(DATA_AXIS, None, None, None))
+        from wormhole_tpu.learners.store import mesh_step_specs
+        Pm, Pblk, _ = mesh_step_specs(have_model)
         Pmlp = jax.tree.map(lambda _: P(), self.mlp)
         data_specs = (Pm, Pmlp, Pmlp, Pblk, P(DATA_AXIS, None),
                       P(DATA_AXIS, None), P(DATA_AXIS, None))
@@ -413,7 +415,7 @@ class WideDeepStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         (self.slots, self.mlp, self.mlp_accum, t_new,
          self._macc) = step(self.slots, self.mlp, self.mlp_accum,
                             blocks["pw"], blocks["labels"],
@@ -427,7 +429,7 @@ class WideDeepStore(TableCheckpoint):
     def tile_eval_step_mesh(self, blocks: dict, info):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
-        z = np.zeros((D, max(oc, 1)), np.uint32)
+        z = mesh_ovf_zeros(D, oc)
         return self._tile_step_mesh(info, "eval")(
             self.slots, self.mlp, self.mlp_accum, blocks["pw"],
             blocks["labels"], blocks.get("ovf_b", z),
@@ -435,14 +437,15 @@ class WideDeepStore(TableCheckpoint):
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block wide&deep step; metrics accumulate ON DEVICE
-        (fetch_metrics, same harvest pipeline as ShardedStore)."""
+        (fetch_metrics, same harvest pipeline as ShardedStore). Returns
+        the non-donated completion ticket, never the clock."""
         step = self._tile_step(info, "train")
-        (self.slots, self.mlp, self.mlp_accum, t_new,
-         self._macc) = step(self.slots, self.mlp, self.mlp_accum, block,
-                            self._t_device(), self._tau_const(tau),
-                            self._macc_buf())
+        (self.slots, self.mlp, self.mlp_accum, t_new, self._macc,
+         ticket) = step(self.slots, self.mlp, self.mlp_accum, block,
+                        self._t_device(), self._tau_const(tau),
+                        self._macc_buf())
         self._advance_t(t_new)
-        return t_new
+        return ticket
 
     def tile_eval_step(self, block: dict, info):
         return self._tile_step(info, "eval")(self.slots, self.mlp, block)

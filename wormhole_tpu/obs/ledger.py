@@ -70,6 +70,13 @@ SPAN_TABLE: Dict[str, str] = {
     # device step dispatch + blocking wait on inflight results
     "dispatch": "device_compute",
     "wait": "device_compute",
+    # multi-device mesh path: group dispatch and the spill scatter step
+    # are device work; the sync-mode hot-loop group stack is host prep
+    # (the ring mode moves it into the feed's ``stack`` stage below)
+    "mesh:dispatch": "device_compute",
+    "mesh:spill": "device_compute",
+    "mesh:stack": "host_prep",
+    "stack": "host_prep",
     # metrics ticket readback on the host
     "read": "metrics_readback",
     "collective:metrics_window": "metrics_readback",
@@ -107,7 +114,7 @@ SPAN_TABLE: Dict[str, str] = {
 # (the feed name varies; the stage vocabulary is fixed in pipeline.py).
 _FEED_STAGES = {"parse": "host_prep", "prep": "host_prep",
                 "pad": "host_prep", "encode": "encode",
-                "put": "h2d_transfer"}
+                "stack": "host_prep", "put": "h2d_transfer"}
 
 
 def span_bucket(name: str, cat: str = "") -> Optional[str]:

@@ -406,3 +406,28 @@ def encode_counters(reg: Optional[Registry] = None):
             reg.counter("feed/tile_fallback_blocks",
                         help="online-encoded blocks whose COO overflow "
                              "fell back to the audited scatter step"))
+
+
+def mesh_feed_gauges(reg: Optional[Registry] = None):
+    """The sharded mesh-feed (data/crec.MeshGroupFeed) telemetry —
+    single declaration site (lint_knobs uniqueness contract), fetched
+    per call like :func:`encode_counters`. Skew is the arrival-time
+    spread between the first and last block of a data-axis group on the
+    feed dispatcher — the per-device straggler signal: one slow block
+    delays its whole group's dispatch by exactly this much."""
+    reg = reg if reg is not None else default_registry()
+    return (reg.gauge("mesh/dispatch_skew_ms",
+                      help="mean per-group block arrival skew on the "
+                           "mesh feed dispatcher, milliseconds"),
+            reg.gauge("mesh/dispatch_skew_ms_max",
+                      help="worst per-group block arrival skew, "
+                           "milliseconds", agg="max"),
+            reg.counter("mesh/feed_groups",
+                        help="data-axis block groups dispatched through "
+                             "the sharded mesh feed"),
+            reg.counter("mesh/pad_blocks",
+                        help="all-PAD filler blocks stacked into short "
+                             "tail groups"),
+            reg.counter("mesh/spill_blocks",
+                        help="encode-overflow spill batches that rode "
+                             "the mesh feed ring to the scatter step"))

@@ -136,6 +136,14 @@ class MeshRuntime:
     def model_axis_size(self) -> int:
         return self.mesh.shape.get(MODEL_AXIS, 1)
 
+    @property
+    def have_model(self) -> bool:
+        """True when the mesh really shards parameters: a model axis of
+        size > 1. Every mesh step keys its PartitionSpecs off this, so
+        the sharded feed (data/crec.MeshGroupFeed) must use the same
+        predicate to pre-place groups on the layout the step expects."""
+        return self.model_axis_size > 1 and MODEL_AXIS in self.mesh.axis_names
+
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 

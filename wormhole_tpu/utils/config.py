@@ -185,6 +185,14 @@ class Config:
     # "off" keeps the existing scatter/dense paths. crec2 files are
     # already tile-grouped and ignore this knob.
     tile_online: str = "auto"
+    # multi-device crec/crec2 feed (data/crec.MeshGroupFeed): "ring"
+    # assembles each data-axis group of D blocks on the pipeline prep
+    # workers and device_puts it onto its (data, model) NamedSharding
+    # from the transfer thread, so stacking and H2D overlap the mesh
+    # step; "sync" keeps the synchronous stack+jit-transfer dispatch
+    # (the pre-scale-out path, kept as the measured baseline for
+    # bench.py --phases multichip). Single-device runs ignore this knob.
+    mesh_feed: str = "ring"
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 1   # save a checkpoint every N data passes

@@ -647,6 +647,66 @@ def bench_channel_ratios(path: str, stores=None) -> dict:
             "wd_step_over_scalar": round(wd_r[len(wd_r) // 2], 2)}
 
 
+def bench_tile_fused(path: str) -> dict:
+    """Fused one-grid train step vs the split fwd/bwd oracle on
+    IDENTICAL crec2 blocks, timed interleaved in the same windows (the
+    bench_channel_ratios methodology) so the fused/split ratio is
+    contention-robust on the shared chip. The ratio is gated >= 1.0 by
+    scripts/bench_check.py --min-fused-ratio: a fused kernel slower
+    than the two calls it replaces fails the trajectory."""
+    import dataclasses
+
+    import jax
+    from wormhole_tpu.data.crec import PackedFeed, read_header2
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    # the bench file carries a spill capacity; the handful of overflow
+    # pairs is dropped from BOTH paths (ovf_cap=0 view of the same
+    # blocks) so the comparison is operand-identical — a file-level
+    # spill capacity would force the fused store to resolve split
+    info = dataclasses.replace(read_header2(path), ovf_cap=0)
+    blocks = []
+    for dev, _h, _r in PackedFeed(path, 0, 1, fmt="crec2"):
+        blocks.append(dev)
+        if len(blocks) >= 2:
+            break
+    stores = {
+        mode: ShardedStore(
+            StoreConfig(num_buckets=NUM_BUCKETS, loss="logit",
+                        tile_step_kernel=mode),
+            FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0)))
+        for mode in ("fused", "split")}
+
+    def run(store, steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            store.tile_train_step(blocks[i % len(blocks)], info)
+        jax.block_until_ready(store.slots)
+        float(np.asarray(store.slots[0, 0]))
+        return time.perf_counter() - t0
+
+    for s in stores.values():
+        run(s, 2)                      # compile/warm
+    best = {m: float("inf") for m in stores}
+    ratios = []
+    for _ in range(5):
+        t = {m: run(s, 4) / 4 for m, s in stores.items()}
+        for m, v in t.items():
+            best[m] = min(best[m], v)
+        # ratio per interleaved pass, median across passes — a
+        # per-store min could pair different contention bursts
+        ratios.append(t["split"] / t["fused"])
+        if _deadline_passed():
+            break
+    ratios.sort()
+    return {
+        "tile_fused_ex_per_sec": round(info.block_rows / best["fused"], 1),
+        "tile_split_ex_per_sec": round(info.block_rows / best["split"], 1),
+        "fused_over_split": round(ratios[len(ratios) // 2], 3),
+        "resolved_kernel": stores["fused"].step_kernel[0]}
+
+
 def bench_kmeans() -> dict:
     """k-means iteration time at the MNIST-784 shape (BASELINE.json's
     learn/kmeans config: dense 60000 x 784, k=10). One BSP iteration =
@@ -1546,13 +1606,13 @@ def bench_multichip() -> dict:
 # uses.
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
-          "channel_ratios", "device_sparse", "device_dense_apply",
-          "scale_curve", "multichip", "serve", "comm_filters",
-          "async_ps", "kmeans", "lbfgs", "gbdt", "chaos"]
+          "channel_ratios", "tile_fused", "device_sparse",
+          "device_dense_apply", "scale_curve", "multichip", "serve",
+          "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt", "chaos"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
-_CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream"}
+_CREC2_PHASES = _STORE_PHASES | {"e2e_crec2", "e2e_stream", "tile_fused"}
 _DEFAULT_BUDGET = 840.0  # under the 15-min harness timeout, with margin
 
 
@@ -1638,6 +1698,8 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
     if "channel_ratios" in results:
         extra["channel_step_ratios_same_window"] = \
             results["channel_ratios"]
+    if "tile_fused" in results:
+        extra["tile_fused_vs_split"] = results["tile_fused"]
     if "scale_curve" in results:
         extra["scale_curve_tile_step"] = results["scale_curve"]
     if "serve" in results:
@@ -1780,6 +1842,7 @@ def main(argv=None) -> None:
             crec2_path, stores()["wd"]),
         "channel_ratios": lambda: bench_channel_ratios(crec2_path,
                                                        stores()),
+        "tile_fused": lambda: bench_tile_fused(crec2_path),
         "device_sparse": bench_device_sparse,
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),

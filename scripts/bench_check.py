@@ -71,6 +71,7 @@ _RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
 # exposure.
 _LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
 _SCALE_PAT = re.compile(r"scaling_efficiency$")
+_FUSED_PAT = re.compile(r"fused_over_split$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
 # default --min-scaling: the measured CPU fake-8-device trajectory sits
 # at 0.09-0.13 across the swept shapes (all "devices" share the host
@@ -78,6 +79,11 @@ _LEDGER_FRACS = ("unattributed", "residual_stall")
 # while catching a mesh feed that serializes outright (efficiency ->
 # 1/n^2 territory)
 _MIN_SCALING = 0.05
+# absolute floor on the newest BENCH run's *fused_over_split ratio
+# (bench.py --phases tile_fused, same-window interleaved): the fused
+# one-grid step exists to beat the two calls it replaces, so < 1.0 is a
+# regression by definition, not a tolerance question
+_MIN_FUSED_RATIO = 1.0
 
 
 def load_runs(bench_dir: str,
@@ -236,15 +242,35 @@ def scaling_floor(name: str, parsed: dict,
         if v < min_scaling]
 
 
+def fused_ratio_keys(parsed: dict) -> Dict[str, float]:
+    """``*fused_over_split`` ratio keys (tile_fused phase)."""
+    return _keys_matching(parsed, _FUSED_PAT)
+
+
+def fused_floor(name: str, parsed: dict, min_ratio: float) -> List[str]:
+    """Absolute floor on the newest run's fused/split step ratio: the
+    fused kernel replacing the split pair must not be slower than it
+    (the measurement is same-window interleaved, so the ratio holds
+    even on a contended chip)."""
+    return [
+        f"{key}: {v:.3f} < --min-fused-ratio {min_ratio:.3f} ({name}) "
+        "— fused tile step slower than the split oracle it replaces"
+        for key, v in sorted(fused_ratio_keys(parsed).items())
+        if v < min_ratio]
+
+
 def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      tol_frac: float, all_pairs: bool,
-                     min_scaling: float) -> Tuple[List[str], int, int]:
+                     min_scaling: float,
+                     min_fused_ratio: float) -> Tuple[List[str], int, int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
             if p is not None]
     failures: List[str] = []
     if prefix == "MULTICHIP" and runs:
         failures.extend(scaling_floor(*runs[-1], min_scaling))
+    if prefix == "BENCH" and runs:
+        failures.extend(fused_floor(*runs[-1], min_fused_ratio))
     if len(runs) < 2:
         print(f"bench_check: {len(runs)} usable {prefix} run(s) under "
               f"{bench_dir!r}; nothing to gate pairwise")
@@ -260,12 +286,14 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
 
 
 def run(bench_dir: str, tol: float, tol_frac: float,
-        all_pairs: bool = False, min_scaling: float = _MIN_SCALING) -> int:
+        all_pairs: bool = False, min_scaling: float = _MIN_SCALING,
+        min_fused_ratio: float = _MIN_FUSED_RATIO) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
         f, p, c = _gate_trajectory(prefix, bench_dir, tol, tol_frac,
-                                   all_pairs, min_scaling)
+                                   all_pairs, min_scaling,
+                                   min_fused_ratio)
         failures.extend(f)
         pairs += p
         compared += c
@@ -298,12 +326,19 @@ def main(argv=None) -> int:
                          "*scaling_efficiency values (default "
                          f"{_MIN_SCALING}; the CPU fake-mesh trajectory "
                          "measures ~1/n_devices)")
+    ap.add_argument("--min-fused-ratio", type=float,
+                    default=_MIN_FUSED_RATIO,
+                    help="absolute floor on the newest BENCH run's "
+                         "*fused_over_split ratio (default "
+                         f"{_MIN_FUSED_RATIO}; the fused step must not "
+                         "be slower than the split oracle)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
     args = ap.parse_args(argv)
     return run(args.dir, args.tol, args.tol_frac,
-               all_pairs=args.all_pairs, min_scaling=args.min_scaling)
+               all_pairs=args.all_pairs, min_scaling=args.min_scaling,
+               min_fused_ratio=args.min_fused_ratio)
 
 
 if __name__ == "__main__":

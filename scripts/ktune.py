@@ -3,6 +3,13 @@ on real TPU hardware, checks them against the exact numpy oracle, and
 sweeps tiles_step. Not part of the bench; a dev tool.
 
 Usage: python scripts/ktune.py [reps] [tb1,tb2,...]
+       python scripts/ktune.py --kernel fused|split|both [reps]
+
+``--kernel`` times the full FTRL train step instead of the bare
+fwd/bwd pair; ``both`` is the A/B mode — each window times split and
+fused back-to-back, so the per-window ratio is contention-robust on
+the shared chip (the round-4/5 interleaved methodology) even when the
+absolute times are not.
 """
 from __future__ import annotations
 
@@ -48,10 +55,90 @@ def timeit(fn, *args, reps=15, burn=100, windows=10):
     return best
 
 
+def _build_ab_steps(spec, which):
+    """Jitted full train steps for the --kernel A/B: the split oracle
+    (fwd pallas_call -> XLA dual -> bwd pallas_call -> XLA push) and
+    the fused one-grid step with the in-place FTRL update."""
+    import jax.numpy as jnp
+
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.ops.loss import create_loss
+    from wormhole_tpu.ops.penalty import L1L2
+
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    _, dual_fn = create_loss("logit")
+    steps = {}
+    if which in ("split", "both"):
+        @jax.jit
+        def split_step(pw, s32, labels, mask):
+            w = handle.weights(s32)
+            margin = tilemm.forward_margins(pw, w, spec)
+            dual = dual_fn(margin, labels, mask)
+            grad = tilemm.backward_grad(pw, dual, spec)
+            new = handle.push(s32, grad, jnp.float32(0), jnp.float32(0))
+            return margin, new
+        steps["split"] = split_step
+    if which in ("fused", "both"):
+        @jax.jit
+        def fused_step(pw, s32, labels, mask):
+            return tilemm.fused_step_update(pw, s32, labels, mask,
+                                            spec, "logit", handle)
+        steps["fused"] = fused_step
+    return handle, steps
+
+
+def _kernel_ab(spec, pw, which, reps, windows=10, burn=20):
+    """Time the resolved train-step kernels; in ``both`` mode each
+    window runs split then fused back-to-back and the reported ratio
+    is the median of the per-window ratios."""
+    rng = np.random.default_rng(1)
+    handle, steps = _build_ab_steps(spec, which)
+    s32 = jax.device_put(
+        rng.normal(0, 0.1, (spec.nb, handle.val_len)).astype(np.float32))
+    labels = jax.device_put(
+        (rng.random(spec.block_rows) < 0.5).astype(np.float32))
+    mask = jax.device_put(np.ones(spec.block_rows, np.float32))
+    for name, fn in steps.items():
+        o = None
+        for _ in range(burn):
+            o = fn(pw, s32, labels, mask)
+        _force(o)
+    best = {name: float("inf") for name in steps}
+    ratios = []
+    for _ in range(windows):
+        win = {}
+        for name, fn in steps.items():
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(reps):
+                o = fn(pw, s32, labels, mask)
+            _force(o)
+            win[name] = (time.perf_counter() - t0) / reps
+            best[name] = min(best[name], win[name])
+        if len(win) == 2:
+            ratios.append(win["split"] / win["fused"])
+    for name, t in best.items():
+        print(f"{name:5s} step {t*1e3:7.3f} ms -> "
+              f"{spec.block_rows/t/1e6:.2f} M ex/s")
+    if ratios:
+        print(f"split/fused ratio: median {np.median(ratios):.3f} "
+              f"min {min(ratios):.3f} max {max(ratios):.3f} "
+              f"({len(ratios)} interleaved windows)")
+
+
 def main():
-    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    tbs = ([int(x) for x in sys.argv[2].split(",")]
-           if len(sys.argv) > 2 else [])
+    args = list(sys.argv[1:])
+    kernel = None
+    if "--kernel" in args:
+        i = args.index("--kernel")
+        kernel = args[i + 1]
+        if kernel not in ("fused", "split", "both"):
+            raise SystemExit(f"--kernel must be fused|split|both, "
+                             f"got {kernel!r}")
+        del args[i:i + 2]
+    reps = int(args[0]) if len(args) > 0 else 20
+    tbs = ([int(x) for x in args[1].split(",")]
+           if len(args) > 1 else [])
     from wormhole_tpu.data.crec import default_cap
     spec = tilemm.make_spec(NB, ROWS // tilemm.RSUB, default_cap(NNZ, NB))
     print("spec:", spec)
@@ -66,6 +153,13 @@ def main():
     # device-resident operands: numpy args would re-upload ~90 MB per
     # call through the host transport and swamp the kernel timing
     pw, w, dual = (jax.device_put(x) for x in (pw_np, w_np, dual_np))
+
+    if kernel is not None:
+        # full-train-step A/B on the same encoded block; overflow pairs
+        # are dropped from BOTH paths (the fused kernel is dense-only,
+        # so the comparison stays operand-identical)
+        _kernel_ab(spec, pw, kernel, reps)
+        return
 
     slots = spec.tiles * spec.subblocks * spec.cap
     # MXU N-row pass floor: passes x slots x 16384 MAC @ 98.5e12 MAC/s

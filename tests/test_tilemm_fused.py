@@ -1,0 +1,337 @@
+"""Fused one-grid train step vs the split fwd/bwd oracle — BITWISE.
+
+The fused kernel (`tile_step_kernel=fused`, ops/tilemm.py) promises bit
+parity with the split pallas pair it replaces: same margins, same
+gradient, same post-update w/z/n slots. These tests pin that contract in
+interpret mode on CPU, at the tilemm level (kernel vs the composed
+fwd -> dual -> bwd chain) and at the store level (whole train steps,
+slots AND the packed metric accumulator), across linear / FM /
+wide&deep, plus the structural fallbacks: a capped-overflow block that
+exercises the COO spill path and a data:2,model:4 mesh shard, both of
+which must resolve split and keep their existing bits.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.ops import tilemm
+
+SPEC = tilemm.TileSpec(nb=2 * tilemm.TILE, subblocks=2, cap=1280,
+                       group=2, tiles_step=2)
+
+
+def make_pairs(rng, n_pairs, spec=SPEC):
+    buckets = rng.integers(0, spec.nb, size=n_pairs).astype(np.int64)
+    rows = rng.integers(0, spec.block_rows, size=n_pairs).astype(np.int64)
+    return buckets, rows
+
+
+def make_block(rng, spec=SPEC, n_pairs=3000, pad_rows=100):
+    """Encoded block + u8 labels (255 = padding) for store-level steps."""
+    buckets, rows = make_pairs(rng, n_pairs, spec)
+    pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+    assert not len(ovb)
+    labels = rng.integers(0, 2, size=spec.block_rows).astype(np.uint8)
+    if pad_rows:
+        labels[-pad_rows:] = 255
+    return pw, labels
+
+
+def make_info(spec=SPEC, ovf_cap=0):
+    from wormhole_tpu.data.crec import CRec2Info
+    return CRec2Info(nnz=0, block_rows=spec.block_rows,
+                     total_rows=spec.block_rows, nb=spec.nb,
+                     subblocks=spec.subblocks, cap=spec.cap,
+                     ovf_cap=ovf_cap)
+
+
+def test_resolve_step_kernel():
+    """Structural inadmissibility always wins and always says why."""
+    r = tilemm.resolve_step_kernel
+    assert r("fused") == ("fused", "")
+    assert r("split")[0] == "split"
+    # forced fused still yields split when the geometry can't fuse
+    mode, why = r("fused", ovf_cap=64)
+    assert mode == "split" and "spill" in why
+    mode, why = r("fused", mesh=True)
+    assert mode == "split" and "mesh" in why
+    mode, why = r("fused", deep=True)
+    assert mode == "split" and "vjp" in why
+    mode, why = r("auto")          # CPU backend under the test runner
+    assert mode == "split" and "backend" in why
+    with pytest.raises(ValueError, match="tile_step_kernel"):
+        r("bogus")
+
+
+def test_fused_spans_are_device_compute():
+    """The fused dispatches are single pallas calls: their ledger spans
+    must bucket as pure device work, and stay in SPAN_TABLE so
+    lint_spans keeps covering them."""
+    from wormhole_tpu.obs import ledger
+    assert ledger.SPAN_TABLE["tilemm:fused_step"] == "device_compute"
+    assert ledger.SPAN_TABLE["tilemm:fused_multi"] == "device_compute"
+    assert ledger.span_bucket("tilemm:fused_step") == "device_compute"
+    assert ledger.span_bucket("tilemm:fused_multi") == "device_compute"
+
+
+@pytest.mark.parametrize("loss,exact_dense", [
+    ("logit", True), ("hinge", False),
+    ("square_hinge", True), ("square", False)])
+def test_fused_step_grad_bitwise(loss, exact_dense):
+    """Kernel-level: one-grid margins+dual+grad == the split chain
+    (fwd pallas -> XLA dual [-> nudge] -> bwd pallas), bit for bit."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.store import _nudge_zero_dual
+    from wormhole_tpu.ops.loss import create_loss
+
+    rng = np.random.default_rng(3)
+    buckets, rows = make_pairs(rng, 4000)
+    pw, _, _ = tilemm.encode_block(buckets, rows, SPEC)
+    w = (rng.standard_normal(SPEC.nb) * 0.1).astype(np.float32)
+    labels = (rng.random(SPEC.block_rows) < 0.4).astype(np.float32)
+    mask = np.ones(SPEC.block_rows, np.float32)
+    mask[-64:] = 0.0
+    _, dual_fn = create_loss(loss)
+
+    @jax.jit
+    def split(pw, w, labels, mask):
+        margin = tilemm.forward_margins(pw, w, SPEC)
+        dual = dual_fn(margin, labels, mask)
+        if not exact_dense:
+            dual = _nudge_zero_dual(dual, labels, mask)
+        return margin, tilemm.backward_grad(pw, dual, SPEC)
+
+    @jax.jit
+    def fused(pw, w, labels, mask):
+        return tilemm.fused_step_grad(pw, w, labels, mask, SPEC, loss,
+                                      exact_dense)
+
+    args = (jnp.asarray(pw), jnp.asarray(w), jnp.asarray(labels),
+            jnp.asarray(mask))
+    mg_s, g_s = split(*args)
+    mg_f, g_f = fused(*args)
+    np.testing.assert_array_equal(np.asarray(mg_f), np.asarray(mg_s))
+    np.testing.assert_array_equal(np.asarray(g_f), np.asarray(g_s))
+
+
+def test_fused_step_update_bitwise():
+    """Kernel-level in-place FTRL: the update that runs inside the grid
+    (the gradient never reaches HBM) produces the same post-update
+    w/z/n slots as split grad -> handle.push."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.ops.loss import create_loss
+    from wormhole_tpu.ops.penalty import L1L2
+
+    rng = np.random.default_rng(4)
+    buckets, rows = make_pairs(rng, 4000)
+    pw, _, _ = tilemm.encode_block(buckets, rows, SPEC)
+    s32 = (rng.standard_normal((SPEC.nb, 3)) * 0.1).astype(np.float32)
+    s32[:, 2] = np.abs(s32[:, 2])           # n slot is a running sum-sq
+    labels = (rng.random(SPEC.block_rows) < 0.4).astype(np.float32)
+    mask = np.ones(SPEC.block_rows, np.float32)
+    handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+    _, dual_fn = create_loss("logit")
+
+    @jax.jit
+    def split(pw, s32, labels, mask):
+        w = handle.weights(s32)
+        margin = tilemm.forward_margins(pw, w, SPEC)
+        dual = dual_fn(margin, labels, mask)
+        grad = tilemm.backward_grad(pw, dual, SPEC)
+        return margin, handle.push(s32, grad, jnp.float32(0),
+                                   jnp.float32(0))
+
+    @jax.jit
+    def fused(pw, s32, labels, mask):
+        return tilemm.fused_step_update(pw, s32, labels, mask, SPEC,
+                                        "logit", handle)
+
+    args = (jnp.asarray(pw), jnp.asarray(s32), jnp.asarray(labels),
+            jnp.asarray(mask))
+    mg_s, new_s = split(*args)
+    mg_f, new_f = fused(*args)
+    np.testing.assert_array_equal(np.asarray(mg_f), np.asarray(mg_s))
+    np.testing.assert_array_equal(np.asarray(new_f), np.asarray(new_s))
+
+
+def _run_linear(blocks, info, kernel, loss, algo, seed=1):
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.handles import LearnRate, create_handle
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+
+    st = ShardedStore(
+        StoreConfig(num_buckets=info.nb, loss=loss,
+                    tile_step_kernel=kernel),
+        create_handle(algo, L1L2(1.0, 0.1), LearnRate(0.1, 1.0)))
+    rng = np.random.default_rng(seed)
+    st.slots = jnp.asarray(
+        (rng.standard_normal(st.slots.shape) * 0.1).astype(np.float32))
+    for blk in blocks:
+        st.tile_train_step(blk, info)
+    jax.block_until_ready(st.slots)
+    return np.asarray(st.slots), np.asarray(st._macc), st.step_kernel
+
+
+@pytest.mark.parametrize("loss,algo,resolved", [
+    ("logit", "ftrl", "fused_update"),
+    ("hinge", "adagrad", "fused"),
+    ("square_hinge", "ftrl", "fused_update")])
+def test_store_step_parity(loss, algo, resolved):
+    """Whole linear train steps: slots AND the packed metric accumulator
+    stay bitwise across kernels, including padded (label 255) rows. The
+    forced-fused store must have resolved the expected variant."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    info = make_info()
+    blocks = []
+    for _ in range(2):
+        pw, labels = make_block(rng)
+        blocks.append({"pw": jnp.asarray(pw), "labels": jnp.asarray(labels)})
+    s_f, m_f, k_f = _run_linear(blocks, info, "fused", loss, algo)
+    s_s, m_s, k_s = _run_linear(blocks, info, "split", loss, algo)
+    assert k_f == (resolved, "")
+    assert k_s == ("split", "forced")
+    np.testing.assert_array_equal(s_f, s_s)
+    np.testing.assert_array_equal(m_f, m_s)
+
+
+def test_fm_store_step_parity():
+    """FM: the multi-channel one-grid step (margins + dual-channel push
+    grid, pulls never in HBM) keeps slots and metrics bitwise."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.models.fm import FMConfig, FMStore
+
+    rng = np.random.default_rng(6)
+    info = make_info()
+    blocks = []
+    for _ in range(2):
+        pw, labels = make_block(rng)
+        blocks.append({"pw": jnp.asarray(pw), "labels": jnp.asarray(labels)})
+
+    def run(kernel):
+        st = FMStore(FMConfig(num_buckets=info.nb, dim=4, loss="logit",
+                              l1=0.5, l2=0.05, seed=7,
+                              tile_step_kernel=kernel))
+        for blk in blocks:
+            st.tile_train_step(blk, info)
+        jax.block_until_ready(st.slots)
+        return np.asarray(st.slots), np.asarray(st._macc), st.step_kernel
+
+    s_f, m_f, k_f = run("fused")
+    s_s, m_s, k_s = run("split")
+    assert k_f == ("fused", "")
+    assert k_s[0] == "split"
+    np.testing.assert_array_equal(s_f, s_s)
+    np.testing.assert_array_equal(m_f, m_s)
+
+
+def test_wide_deep_always_resolves_split():
+    """wide&deep can't fuse — the MLP vjp runs between the embedding
+    pulls and the pushes — so forcing fused must quietly resolve split
+    (reason recorded) and change nothing."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.models.wide_deep import (WideDeepConfig,
+                                               WideDeepStore)
+
+    rng = np.random.default_rng(7)
+    info = make_info()
+    pw, labels = make_block(rng)
+    blk = {"pw": jnp.asarray(pw), "labels": jnp.asarray(labels)}
+
+    def run(kernel):
+        st = WideDeepStore(WideDeepConfig(num_buckets=info.nb, dim=4,
+                                          hidden=(8,), seed=3,
+                                          tile_step_kernel=kernel))
+        st.tile_train_step(blk, info)
+        jax.block_until_ready(st.slots)
+        return np.asarray(st.slots), st.step_kernel
+
+    s_f, k_f = run("fused")
+    s_s, k_s = run("split")
+    assert k_f[0] == "split" and "vjp" in k_f[1]
+    np.testing.assert_array_equal(s_f, s_s)
+
+
+def test_spill_block_falls_back_split_bitwise():
+    """A capped-overflow block (hot bucket past `cap`) is structurally
+    unfusable: the COO spill scatter adds margins between the phases.
+    Both knob settings must resolve split, run the spill path, and
+    produce identical bits."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(8)
+    buckets, rows = make_pairs(rng, 3000)
+    hot = 7 * tilemm.TILE // 4
+    buckets = np.concatenate([buckets, np.full(1400, hot, np.int64)])
+    rows = np.concatenate(
+        [rows, rng.integers(0, tilemm.RSUB, size=1400).astype(np.int64)])
+    pw, ovb, ovr = tilemm.encode_block(buckets, rows, SPEC)
+    assert len(ovb) > 0
+    oc = 1536
+    pad_b = np.full(oc, 0xFFFFFFFF, np.uint32)
+    pad_r = np.zeros(oc, np.uint32)
+    pad_b[:len(ovb)], pad_r[:len(ovr)] = ovb, ovr
+    labels = rng.integers(0, 2, size=SPEC.block_rows).astype(np.uint8)
+    blk = {"pw": jnp.asarray(pw), "labels": jnp.asarray(labels),
+           "ovf_b": jnp.asarray(pad_b), "ovf_r": jnp.asarray(pad_r)}
+    info = make_info(ovf_cap=oc)
+
+    s_f, m_f, k_f = _run_linear([blk], info, "fused", "logit", "ftrl")
+    s_s, m_s, k_s = _run_linear([blk], info, "split", "logit", "ftrl")
+    # the structural reason outranks "forced" on both knob settings
+    assert k_f[0] == "split" and "spill" in k_f[1]
+    assert k_s[0] == "split" and "spill" in k_s[1]
+    np.testing.assert_array_equal(s_f, s_s)
+    np.testing.assert_array_equal(m_f, m_s)
+
+
+def test_mesh_shard_unaffected_by_step_kernel():
+    """The data:2,model:4 mesh path always runs the split shard_map step
+    (psums sit between the phases); the knob must neither break it nor
+    change its bits."""
+    import jax
+    import jax.numpy as jnp
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+
+    rng = np.random.default_rng(9)
+    nb = 4 * tilemm.TILE            # one tile per model shard
+    spec = tilemm.make_spec(nb, subblocks=2, cap=1280)
+    from wormhole_tpu.data.crec import CRec2Info
+    info = CRec2Info(nnz=8, block_rows=spec.block_rows,
+                     total_rows=2 * spec.block_rows, nb=nb,
+                     subblocks=2, cap=spec.cap, ovf_cap=0)
+    blocks = {"pw": [], "labels": []}
+    for _ in range(2):
+        buckets, rows = make_pairs(rng, 3000, spec)
+        pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+        assert not len(ovb)
+        labels = (rng.random(spec.block_rows) < 0.4).astype(np.uint8)
+        blocks["pw"].append(pw)
+        blocks["labels"].append(labels)
+    blocks = {k: np.stack(v) for k, v in blocks.items()}
+
+    def run(kernel):
+        rt = MeshRuntime.create()
+        rt.mesh = make_mesh("data:2,model:4", jax.devices()[:8])
+        st = ShardedStore(
+            StoreConfig(num_buckets=nb, loss="logit",
+                        tile_step_kernel=kernel),
+            FTRLHandle(penalty=L1L2(0.1, 0.01), lr=LearnRate(0.5, 1.0)),
+            rt)
+        st.tile_train_step_mesh(blocks, info)
+        return np.asarray(jax.device_get(st.slots))
+
+    np.testing.assert_array_equal(run("fused"), run("split"))

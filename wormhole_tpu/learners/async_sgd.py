@@ -82,7 +82,8 @@ class AsyncSGD:
                             loss=cfg.loss.value,
                             fixed_bytes=cfg.fixed_bytes,
                             lr_theta=cfg.lr_theta,
-                            param_dtype=cfg.param_dtype),
+                            param_dtype=cfg.param_dtype,
+                            tile_step_kernel=cfg.tile_step_kernel),
                 handle, self.rt)
         elif (buckets := getattr(getattr(store, "cfg", None),
                                  "num_buckets", None)) is not None \
@@ -98,6 +99,8 @@ class AsyncSGD:
             raise ValueError("test_data set but pred_out empty")
         from wormhole_tpu.utils.config import check_choice
         check_choice("tile_online", cfg.tile_online, ("auto", "on", "off"))
+        check_choice("tile_step_kernel", cfg.tile_step_kernel,
+                     ("auto", "fused", "split"))
         self.localizer = Localizer(num_buckets=cfg.num_buckets,
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()

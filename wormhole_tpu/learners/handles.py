@@ -29,6 +29,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from wormhole_tpu.ops.loss import opaque_one
 from wormhole_tpu.ops.penalty import L1L2
 
 
@@ -104,13 +105,24 @@ class FTRLHandle(Handle):
 
     val_len: int = 3
 
-    def push(self, slots, grad, t, tau):
-        w, z, cg = slots[..., 0], slots[..., 1], slots[..., 2]
-        cg_new = jnp.sqrt(cg * cg + grad * grad)
+    def update(self, w, z, cg, grad, one):
+        """The elementwise slot math on unstacked planes — shared by
+        push() and the fused tile-step kernel (ops/tilemm.py), which
+        runs it per weight tile inside the Pallas grid. ``one`` is
+        ``opaque_one(...)``: the ``*one`` guards pin each product to
+        its rounded f32 value so both compilation contexts produce the
+        same bits (fused/split bit parity; see ops/loss.opaque_one)."""
+        cg_new = jnp.sqrt((cg * cg) * one + (grad * grad) * one)
         sigma = (cg_new - cg) / self.lr.alpha
-        z_new = z + grad - sigma * w
+        z_new = (z + grad) - (sigma * w) * one
         w_new = self.penalty.solve(
             -z_new, (self.lr.beta + cg_new) / self.lr.alpha)
+        return w_new, z_new, cg_new
+
+    def push(self, slots, grad, t, tau):
+        w, z, cg = slots[..., 0], slots[..., 1], slots[..., 2]
+        w_new, z_new, cg_new = self.update(w, z, cg, grad,
+                                           opaque_one(grad))
         return jnp.stack([w_new, z_new, cg_new], axis=-1)
 
     def warm_start(self, w):

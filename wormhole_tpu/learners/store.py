@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from wormhole_tpu.data.feed import SparseBatch
-from wormhole_tpu.learners.handles import Handle
+from wormhole_tpu.learners.handles import FTRLHandle, Handle
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.spmv import spmv_times, spmv_trans_times
 from wormhole_tpu.ops.metrics import accuracy, auc
@@ -245,6 +245,9 @@ class StoreConfig:
     param_dtype: str = "float32"  # slots storage dtype; "bfloat16" halves
                                   # table HBM at accumulator-precision cost
                                   # (compute always runs in f32)
+    tile_step_kernel: str = "auto"  # auto|fused|split: one-grid fused
+                                    # train step vs the two-call split
+                                    # oracle (ops/tilemm.py)
 
 
 class TableCheckpoint:
@@ -643,6 +646,7 @@ class ShardedStore(TableCheckpoint):
         key = (info, kind)
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
+            self.step_kernel = self._tile_kernel[key]
             return fn
         exact_dense = zero_grad_push_is_identity(self.handle)
         from wormhole_tpu.ops import tilemm
@@ -650,6 +654,17 @@ class ShardedStore(TableCheckpoint):
         handle, objv_fn, dual_fn = self.handle, self.objv_fn, self.dual_fn
         spec = info.spec
         oc = info.ovf_cap
+        loss_name = self.cfg.loss
+        # The fused one-grid step replaces the fwd/bwd pallas pair when
+        # the geometry admits it (no spill blocks); the in-place slot
+        # update additionally needs an FTRL handle and a single process
+        # (multihost gradients cross the wire before the update, so they
+        # must exist in HBM — the grad-emitting fused variant covers it).
+        mode, why = tilemm.resolve_step_kernel(
+            getattr(self.cfg, "tile_step_kernel", "auto"), ovf_cap=oc)
+        fused = mode == "fused" and kind == "train"
+        fused_update = (fused and isinstance(handle, FTRLHandle)
+                        and jax.process_count() == 1)
 
         def decode(block):
             lab_u8 = block["labels"]
@@ -659,7 +674,48 @@ class ShardedStore(TableCheckpoint):
             ovf_r = block["ovf_r"] if oc else None
             return block["pw"], labels, row_mask, ovf_b, ovf_r
 
-        if kind == "train":
+        def finish(slots, s32, new, margin, labels, row_mask, t, macc):
+            # shared metric tail — identical ops downstream of the
+            # margin/slot buffers in every variant, so the fused paths
+            # keep the split path's metric bits
+            objv = objv_fn(margin, labels, row_mask)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            d0 = new[:, 0] - s32[:, 0]
+            packed = jnp.concatenate([
+                jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
+                pos, neg])
+            # num_ex rides along as the caller's completion ticket:
+            # unlike t+1/macc it never re-enters the donated step
+            # chain, so block_until_ready on it stays legal after
+            # later steps dispatch (donation is real on committed
+            # multi-device layouts, not just TPU)
+            return (new.astype(slots.dtype), t + 1, macc + packed,
+                    num_ex)
+
+        if fused_update:
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                pw, labels, row_mask, _ovf_b, _ovf_r = decode(block)
+                s32 = slots.astype(jnp.float32)
+                margin, new = tilemm.fused_step_update(
+                    pw, s32, labels, row_mask, spec, loss_name, handle)
+                return finish(slots, s32, new, margin, labels, row_mask,
+                              t, macc)
+        elif fused:
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                pw, labels, row_mask, _ovf_b, _ovf_r = decode(block)
+                s32 = slots.astype(jnp.float32)
+                w = handle.weights(s32)
+                margin, grad = tilemm.fused_step_grad(
+                    pw, w, labels, row_mask, spec, loss_name, exact_dense)
+                new = masked_push(handle, s32, grad,
+                                  t.astype(jnp.float32), tau, exact_dense)
+                return finish(slots, s32, new, margin, labels, row_mask,
+                              t, macc)
+        elif kind == "train":
             # per-step metrics ADD into a donated on-device accumulator:
             # the step returns no host-visible value at all, so the
             # steady-state loop fetches ONE (4+2*bins,) buffer per display
@@ -673,7 +729,6 @@ class ShardedStore(TableCheckpoint):
                 w = handle.weights(s32)
                 margin = tilemm.forward_margins(pw, w, spec,
                                                 ovf_b, ovf_r)
-                objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
                 if not exact_dense:
                     dual = _nudge_zero_dual(dual, labels, row_mask)
@@ -681,20 +736,8 @@ class ShardedStore(TableCheckpoint):
                                             ovf_b, ovf_r)
                 new = masked_push(handle, s32, grad,
                                   t.astype(jnp.float32), tau, exact_dense)
-                num_ex = jnp.sum(row_mask)
-                acc = accuracy(labels, margin, row_mask)
-                pos, neg = margin_hist(labels, margin, row_mask)
-                d0 = new[:, 0] - s32[:, 0]
-                packed = jnp.concatenate([
-                    jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
-                    pos, neg])
-                # num_ex rides along as the caller's completion ticket:
-                # unlike t+1/macc it never re-enters the donated step
-                # chain, so block_until_ready on it stays legal after
-                # later steps dispatch (donation is real on committed
-                # multi-device layouts, not just TPU)
-                return (new.astype(slots.dtype), t + 1, macc + packed,
-                        num_ex)
+                return finish(slots, s32, new, margin, labels, row_mask,
+                              t, macc)
         else:
             @jax.jit
             def step(slots, block):
@@ -710,6 +753,18 @@ class ShardedStore(TableCheckpoint):
 
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}
+        if not hasattr(self, "_tile_kernel"):
+            self._tile_kernel = {}
+        if kind != "train":
+            resolved, why = "split", "eval is forward-only"
+        elif fused_update:
+            resolved = "fused_update"
+        elif fused:
+            resolved = "fused"
+        else:
+            resolved = "split"
+        self._tile_kernel[key] = (resolved, why)
+        self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
 
@@ -842,9 +897,16 @@ class ShardedStore(TableCheckpoint):
         — the clock itself is donated into the next step, so it is NOT
         safe to block on."""
         step = self._tile_step(info, "train")
-        self.slots, t_new, self._macc, ticket = step(
-            self.slots, block, self._t_device(), self._tau_const(tau),
-            self._macc_buf())
+        if self.step_kernel[0].startswith("fused"):
+            from wormhole_tpu.obs import trace
+            with trace.span("tilemm:fused_step", cat="tile"):
+                self.slots, t_new, self._macc, ticket = step(
+                    self.slots, block, self._t_device(),
+                    self._tau_const(tau), self._macc_buf())
+        else:
+            self.slots, t_new, self._macc, ticket = step(
+                self.slots, block, self._t_device(), self._tau_const(tau),
+                self._macc_buf())
         self._advance_t(t_new)
         return ticket
 

@@ -50,6 +50,9 @@ class FMConfig:
     l2_v: float = 1e-4            # weight decay on v (in-loss)
     init_scale: float = 0.01      # v init stddev
     seed: int = 0
+    tile_step_kernel: str = "auto"  # auto|fused|split: one-grid fused
+                                    # tile train step vs the two-call
+                                    # split oracle (ops/tilemm.py)
 
 
 def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
@@ -172,8 +175,10 @@ class FMStore(TableCheckpoint):
         key = (info, kind)
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
+            self.step_kernel = self._tile_kernel[key]
             return fn
         from wormhole_tpu.ops import tilemm
+        from wormhole_tpu.ops.loss import opaque_one
         from wormhole_tpu.ops.metrics import margin_hist
         cfg = self.cfg
         k = cfg.dim
@@ -181,6 +186,9 @@ class FMStore(TableCheckpoint):
         penalty = L1L2(cfg.l1, cfg.l2)
         spec = info.spec
         oc = info.ovf_cap
+        mode, why = tilemm.resolve_step_kernel(
+            getattr(cfg, "tile_step_kernel", "auto"), ovf_cap=oc)
+        fused = mode == "fused" and kind == "train"
 
         def decode(block):
             lab_u8 = block["labels"]
@@ -190,59 +198,82 @@ class FMStore(TableCheckpoint):
             ovf_r = block["ovf_r"] if oc else None
             return block["pw"], labels, row_mask, ovf_b, ovf_r
 
+        def make_wpull(s32):
+            w, v = s32[:, 0], s32[:, 1:1 + k]
+            return jnp.concatenate(
+                [w[:, None], v, jnp.sum(v * v, 1, keepdims=True)], axis=1)
+
         def forward(s32, block):
             pw, labels, row_mask, ovf_b, ovf_r = decode(block)
-            w, v = s32[:, 0], s32[:, 1:1 + k]
-            wpull = jnp.concatenate(
-                [w[:, None], v, jnp.sum(v * v, 1, keepdims=True)], axis=1)
-            pulls = tilemm.forward_pulls(pw, wpull, spec, ovf_b, ovf_r)
+            pulls = tilemm.forward_pulls(pw, make_wpull(s32), spec,
+                                         ovf_b, ovf_r)
             s = pulls[:, 1:1 + k]
-            margin = (pulls[:, 0]
-                      + 0.5 * (jnp.sum(s * s, axis=1) - pulls[:, 1 + k]))
+            # same guarded channel-by-channel sum the fused kernel runs
+            # at its phase boundary — keeps split/fused margins bitwise
+            margin = tilemm.fm_margin_math(
+                pulls[:, 0], [s[:, j] for j in range(k)], pulls[:, 1 + k],
+                opaque_one(row_mask))
             return pw, labels, row_mask, ovf_b, ovf_r, s, margin
 
-        if kind == "train":
+        def update(s32, push, margin, labels, row_mask, slots, t, macc):
+            # everything downstream of the push buffer — structurally
+            # identical XLA in the fused and split programs, so the
+            # update/metric bits agree between them
+            theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
+            w, v = theta[:, 0], theta[:, 1:]
+            objv = objv_fn(margin, labels, row_mask)
+            g_w = push[:, 0]
+            touched = push[:, 1 + k] > 0
+            g_v = push[:, 1:1 + k] - v * g_w[:, None] \
+                + cfg.l2_v * v * touched[:, None]
+            grads = jnp.concatenate([g_w[:, None], g_v], axis=1)
+            cg_new = jnp.where(touched[:, None],
+                               jnp.sqrt(cg * cg + grads * grads), cg)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            w_new = penalty.solve(w / eta[:, 0] - g_w, 1.0 / eta[:, 0])
+            v_new = v - eta[:, 1:] * g_v
+            theta_new = jnp.where(
+                touched[:, None],
+                jnp.concatenate([w_new[:, None], v_new], axis=1),
+                theta)
+            new = jnp.concatenate([theta_new, cg_new], axis=1)
+            num_ex = jnp.sum(row_mask)
+            from wormhole_tpu.ops.metrics import accuracy
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            d0 = theta_new[:, 0] - w
+            packed = jnp.concatenate([
+                jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
+                pos, neg])
+            # num_ex = completion ticket; the clock/macc outputs are
+            # donated into the next step (see ShardedStore._tile_step)
+            return (new.astype(slots.dtype), t + 1, macc + packed,
+                    num_ex)
+
+        if fused:
             @partial(jax.jit, donate_argnums=(0, 2, 4))
             def step(slots, block, t, tau, macc):
                 s32 = slots.astype(jnp.float32)
-                theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
-                w, v = theta[:, 0], theta[:, 1:]
+                pw, labels, row_mask, _ovf_b, _ovf_r = decode(block)
+                margin, push = tilemm.fused_fm_step(
+                    pw, make_wpull(s32), labels, row_mask, spec, k,
+                    cfg.loss)
+                return update(s32, push, margin, labels, row_mask,
+                              slots, t, macc)
+        elif kind == "train":
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                s32 = slots.astype(jnp.float32)
                 (pw, labels, row_mask, ovf_b, ovf_r, s,
                  margin) = forward(s32, block)
-                objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
                 dvals = jnp.concatenate(
                     [dual[:, None], dual[:, None] * s,
                      row_mask[:, None]], axis=1)
                 push = tilemm.backward_pushes(pw, dvals, spec,
                                               ovf_b, ovf_r)
-                g_w = push[:, 0]
-                touched = push[:, 1 + k] > 0
-                g_v = push[:, 1:1 + k] - v * g_w[:, None] \
-                    + cfg.l2_v * v * touched[:, None]
-                grads = jnp.concatenate([g_w[:, None], g_v], axis=1)
-                cg_new = jnp.where(touched[:, None],
-                                   jnp.sqrt(cg * cg + grads * grads), cg)
-                eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
-                w_new = penalty.solve(w / eta[:, 0] - g_w, 1.0 / eta[:, 0])
-                v_new = v - eta[:, 1:] * g_v
-                theta_new = jnp.where(
-                    touched[:, None],
-                    jnp.concatenate([w_new[:, None], v_new], axis=1),
-                    theta)
-                new = jnp.concatenate([theta_new, cg_new], axis=1)
-                num_ex = jnp.sum(row_mask)
-                from wormhole_tpu.ops.metrics import accuracy
-                acc = accuracy(labels, margin, row_mask)
-                pos, neg = margin_hist(labels, margin, row_mask)
-                d0 = theta_new[:, 0] - w
-                packed = jnp.concatenate([
-                    jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
-                    pos, neg])
-                # num_ex = completion ticket; the clock/macc outputs are
-                # donated into the next step (see ShardedStore._tile_step)
-                return (new.astype(slots.dtype), t + 1, macc + packed,
-                        num_ex)
+                return update(s32, push, margin, labels, row_mask,
+                              slots, t, macc)
         else:
             @jax.jit
             def step(slots, block):
@@ -258,6 +289,13 @@ class FMStore(TableCheckpoint):
 
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}
+        if not hasattr(self, "_tile_kernel"):
+            self._tile_kernel = {}
+        if kind != "train":
+            self._tile_kernel[key] = ("split", "eval is forward-only")
+        else:
+            self._tile_kernel[key] = ("fused" if fused else "split", why)
+        self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
 
@@ -405,9 +443,16 @@ class FMStore(TableCheckpoint):
         (fetch_metrics, same harvest pipeline as ShardedStore). Returns
         the non-donated completion ticket, never the clock."""
         step = self._tile_step(info, "train")
-        self.slots, t_new, self._macc, ticket = step(
-            self.slots, block, self._t_device(), self._tau_const(tau),
-            self._macc_buf())
+        if self.step_kernel[0] == "fused":
+            from wormhole_tpu.obs import trace
+            with trace.span("tilemm:fused_multi", cat="tile"):
+                self.slots, t_new, self._macc, ticket = step(
+                    self.slots, block, self._t_device(),
+                    self._tau_const(tau), self._macc_buf())
+        else:
+            self.slots, t_new, self._macc, ticket = step(
+                self.slots, block, self._t_device(), self._tau_const(tau),
+                self._macc_buf())
         self._advance_t(t_new)
         return ticket
 
@@ -468,13 +513,14 @@ def main(argv=None) -> int:
 
     args = list(sys.argv[1:] if argv is None else argv)
     conf = args.pop(0) if args and "=" not in args[0] else None
-    shared = {"num_buckets", "loss", "seed"}
+    shared = {"num_buckets", "loss", "seed", "tile_step_kernel"}
     model_keys = {f.name for f in _dc.fields(FMConfig)} - shared
     model_kvs = [a for a in args
                  if a.partition("=")[0].strip() in model_keys]
     cfg = load_config(conf, [a for a in args if a not in model_kvs])
     mcfg = FMConfig(num_buckets=cfg.num_buckets, loss=cfg.loss.value,
-                    seed=cfg.seed)
+                    seed=cfg.seed,
+                    tile_step_kernel=cfg.tile_step_kernel)
     apply_kvs(mcfg, model_kvs)
     rt = MeshRuntime.create(cfg.mesh_shape)
     AsyncSGD(cfg, rt, store=FMStore(mcfg, rt)).run()

@@ -50,6 +50,9 @@ class WideDeepConfig:
     l2_v: float = 1e-5
     init_scale: float = 0.01
     seed: int = 0
+    tile_step_kernel: str = "auto"  # accepted for config parity; the
+                                    # deep MLP vjp always resolves split
+                                    # (ops/tilemm.resolve_step_kernel)
 
 
 def init_mlp(sizes: List[int], rng: np.random.Generator):
@@ -194,11 +197,18 @@ class WideDeepStore(TableCheckpoint):
         key = (info, kind)
         fn = getattr(self, "_tile_cache", {}).get(key)
         if fn is not None:
+            self.step_kernel = self._tile_kernel[key]
             return fn
         from wormhole_tpu.ops import tilemm
         from wormhole_tpu.ops.metrics import margin_hist
         cfg = self.cfg
         k = cfg.dim
+        # validates the knob and records WHY this store never fuses:
+        # the MLP vjp runs between the embedding pulls and the pushes
+        mode, why = tilemm.resolve_step_kernel(
+            getattr(cfg, "tile_step_kernel", "auto"), ovf_cap=info.ovf_cap,
+            deep=True)
+        assert mode == "split"
         n_layers = self.n_layers
         objv_fn = self.objv_fn
         _, dual_fn = create_loss(cfg.loss)
@@ -279,6 +289,11 @@ class WideDeepStore(TableCheckpoint):
 
         if not hasattr(self, "_tile_cache"):
             self._tile_cache = {}
+        if not hasattr(self, "_tile_kernel"):
+            self._tile_kernel = {}
+        self._tile_kernel[key] = (
+            "split", "eval is forward-only" if kind != "train" else why)
+        self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
 
@@ -524,13 +539,14 @@ def main(argv=None) -> int:
                            val.replace(",", " ").split() if p)
         else:
             rest.append(a)
-    shared = {"num_buckets", "loss", "seed"}
+    shared = {"num_buckets", "loss", "seed", "tile_step_kernel"}
     model_keys = {f.name for f in _dc.fields(WideDeepConfig)} - shared
     model_kvs = [a for a in rest
                  if a.partition("=")[0].strip() in model_keys]
     cfg = load_config(conf, [a for a in rest if a not in model_kvs])
     mcfg = WideDeepConfig(num_buckets=cfg.num_buckets,
-                          loss=cfg.loss.value, seed=cfg.seed)
+                          loss=cfg.loss.value, seed=cfg.seed,
+                          tile_step_kernel=cfg.tile_step_kernel)
     apply_kvs(mcfg, model_kvs)
     if hidden is not None:
         mcfg.hidden = hidden

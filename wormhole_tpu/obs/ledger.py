@@ -97,6 +97,11 @@ SPAN_TABLE: Dict[str, str] = {
     # attributable but outside the step loop proper
     "checkpoint:*": "other",
     "gbdt:chunk_read": "other",
+    # fused one-grid tile train step (ops/tilemm.py,
+    # tile_step_kernel=fused): the whole fwd+dual+bwd+update grid is one
+    # pallas dispatch, so the span is pure device work
+    "tilemm:fused_step": "device_compute",
+    "tilemm:fused_multi": "device_compute",
     # online serving (serve/): the pull-only forward is device work;
     # the snapshot hot-swap is a reference assignment outside any step
     "serve:forward": "device_compute",

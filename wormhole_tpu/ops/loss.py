@@ -19,6 +19,21 @@ def _to_pm1(labels: jax.Array) -> jax.Array:
     return 2.0 * (labels > 0.5) - 1.0
 
 
+def opaque_one(x: jax.Array) -> jax.Array:
+    """A runtime 1.0f no compiler can constant-fold (float x·0 is not
+    foldable under IEEE semantics — x could be NaN or inf). Multiplying
+    a product by this before an add/sub pins the product to its rounded
+    f32 value even when the backend contracts mul→add chains into FMAs:
+    ``fma(p, 1, a)`` and ``p·1 + a`` round identically, so a guarded
+    expression produces the same bits in every compilation context. The
+    fused/split tile-step bit-parity contract (ops/tilemm.py) rests on
+    this — the same dual/update math runs once inside a Pallas kernel
+    and once in XLA, and unguarded chains contract differently per
+    context (measured: ~1e-3 of elements drift 1 ulp)."""
+    x = x.ravel()[0] if getattr(x, "ndim", 0) else x
+    return x * jnp.float32(0.0) + jnp.float32(1.0)
+
+
 def logit_objv(margin: jax.Array, labels: jax.Array,
                mask: jax.Array) -> jax.Array:
     """Σ log(1 + exp(-y·m)) over real rows (stable via softplus)."""
@@ -42,9 +57,12 @@ def hinge_objv(margin: jax.Array, labels: jax.Array,
 
 def hinge_dual(margin: jax.Array, labels: jax.Array,
                mask: jax.Array) -> jax.Array:
-    """Subgradient: -y where the margin is violated, else 0."""
+    """Subgradient: -y where the margin is violated, else 0. The y·m
+    product is *one-guarded: an FMA formed over ``1 - y·m`` shifts the
+    activity threshold by an ulp, flipping boundary rows per context."""
     y = _to_pm1(labels)
-    active = (1.0 - y * margin > 0).astype(margin.dtype)
+    one = opaque_one(mask)
+    active = (1.0 - (y * margin) * one > 0).astype(margin.dtype)
     return -y * active * mask
 
 
@@ -58,7 +76,8 @@ def square_hinge_objv(margin: jax.Array, labels: jax.Array,
 def square_hinge_dual(margin: jax.Array, labels: jax.Array,
                       mask: jax.Array) -> jax.Array:
     y = _to_pm1(labels)
-    t = jnp.maximum(0.0, 1.0 - y * margin)
+    one = opaque_one(mask)
+    t = jnp.maximum(0.0, 1.0 - (y * margin) * one)
     return -2.0 * y * t * mask
 
 

@@ -292,8 +292,12 @@ def _ohT_vec(vec: jax.Array, shift: int, mask: int, width: int,
     return ((((vec >> shift) & mask)[None, :]) == iota).astype(jnp.bfloat16)
 
 
-def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref):
-    t = pl.program_id(0)
+def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref, t=None):
+    # The fused step kernel invokes this body inside a @pl.when phase
+    # branch, where pl.program_id cannot be read (interpret mode leaves
+    # the primitive unlowered inside cond) — it passes the grid index it
+    # already read at its own top level.
+    t = pl.program_id(0) if t is None else t
 
     @pl.when(t == 0)
     def _():
@@ -593,8 +597,9 @@ def _mask_where(cond: jax.Array, x: jax.Array) -> jax.Array:
     return jnp.where(cond, x, jnp.float32(0)).astype(jnp.bfloat16)
 
 
-def _fwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, w_ref, mg_ref):
-    t = pl.program_id(0)
+def _fwd_multi_kernel(spec: TileSpec, ch: int, pw_ref, w_ref, mg_ref,
+                      t=None):
+    t = pl.program_id(0) if t is None else t
 
     @pl.when(t == 0)
     def _():
@@ -786,6 +791,446 @@ def backward_pushes(pw: jax.Array, dual_rows: jax.Array, spec: TileSpec,
                       0.0)
         g = g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
     return g
+
+
+# ---------------------------------------------------------------------------
+# fused train-step kernels (tile_step_kernel=fused)
+# ---------------------------------------------------------------------------
+#
+# The split formulation runs forward_margins and backward_grad as two
+# pallas_calls with the loss dual (and the FTRL update) in XLA between
+# them, so the (S,RH,RL) margin grid and the (nb,) gradient round-trip
+# HBM every step and the bwd call re-streams the pairs the fwd call just
+# had resident. The fused step is ONE two-phase grid of 2*(T/TB) steps:
+#
+#   phase 1 (t < NT):   the unmodified _fwd_kernel body accumulates the
+#                       margin grid in its (VMEM-resident, constant-
+#                       index) output block;
+#   boundary (t == NT): the loss dual is computed elementwise from the
+#                       margin grid and the labels/row-mask grids passed
+#                       as operands, then written — pre-reshaped and
+#                       cast exactly as the split bwd wrapper does — to
+#                       a VMEM scratch the dual grid never leaves;
+#   phase 2 (t >= NT):  the unmodified _bwd_kernel body (or the K-tile
+#                       _bwd_kernel_fused when spec.fuse > 1) consumes
+#                       the scratch. For the single-process FTRL path
+#                       the per-tile grad never reaches HBM either: a
+#                       _GradSink captures each tile's accumulator and
+#                       the elementwise FTRL update writes the w/z/cg
+#                       slot planes in place via input_output_aliases.
+#
+# Reusing the split kernel BODIES (not re-deriving them) is what makes
+# the split path a bit-parity oracle: both paths run the same bf16
+# one-hot matmuls over the same blocks in the same order, and the dual/
+# update math is elementwise — tests assert margins, grads, and post-
+# update slots bitwise-equal in interpret mode. The COO spill path
+# cannot fuse (its scatter adds margins between the fwd pass and the
+# dual, outside any kernel), so resolve_step_kernel falls back to split
+# whenever ovf_cap > 0 — likewise on the mesh path, where psums over
+# MODEL (margins) and DATA (grads) sit at exactly the two seams the
+# fusion removes.
+
+STEP_KERNELS = ("auto", "fused", "split")
+
+
+def resolve_step_kernel(kernel: str, *, ovf_cap: int = 0,
+                        mesh: bool = False,
+                        deep: bool = False) -> Tuple[str, str]:
+    """Resolve the ``tile_step_kernel`` knob to ``("fused"|"split",
+    why)`` — ``why`` names the reason whenever the resolution is split.
+    Structural inadmissibility (spill, mesh, an MLP between pulls and
+    pushes) wins over a forced ``fused``: unlike ``tile_online=on``
+    this never raises, because ovf_cap is a property of the dataset,
+    not a misconfiguration. ``auto`` resolves to fused only on the TPU
+    backend (mirroring ``gbdt_hist_kernel``); a forced ``fused`` runs
+    anywhere — interpret mode included, which is how the CPU parity
+    tests drive it."""
+    if kernel not in STEP_KERNELS:
+        raise ValueError(f"tile_step_kernel must be one of "
+                         f"{STEP_KERNELS}, got {kernel!r}")
+    if ovf_cap > 0:
+        return "split", ("the COO spill scatter adds margins between "
+                         "the fwd pass and the dual, outside any kernel")
+    if mesh:
+        return "split", ("mesh psums (margins over model, grads over "
+                         "data) sit between the phases the fusion joins")
+    if deep:
+        return "split", ("an MLP vjp runs between the embedding pulls "
+                         "and the pushes")
+    if kernel == "split":
+        return "split", "forced"
+    if kernel == "fused":
+        return "fused", ""
+    if jax.default_backend() == "tpu":
+        return "fused", ""
+    return "split", f"auto on {jax.default_backend()} backend"
+
+
+class _GradSink:
+    """Stands in for ``g_ref`` when the bwd kernel bodies run inside the
+    fused-update phase: they only ever assign whole tiles
+    (``g_ref[tb] = acc``), so capturing the assignments keeps each
+    tile's f32 gradient in registers for the in-place FTRL update
+    instead of routing it through an HBM output."""
+
+    def __init__(self):
+        self.tiles = {}
+
+    def __setitem__(self, tb, acc):
+        self.tiles[tb] = acc
+
+
+def _make_step_kernel(spec: TileSpec, loss: str, exact_dense: bool,
+                      handle, nt: int):
+    """Two-phase scalar kernel body; see the section comment.
+    ``handle`` is None for the grad-emitting variant or an FTRLHandle
+    for the in-place slot update — the kernel calls the handle's own
+    ``update`` on the tile planes, so the in-kernel math can never
+    drift from the split path's push()."""
+    from .loss import create_loss, opaque_one
+    _, dual_fn = create_loss(loss)
+    K = spec.fuse
+
+    def kernel(*refs):
+        if K > 1:
+            pw_ref, wt_ref, lab_ref, msk_ref, pwk_ref, ghic_ref = refs[:6]
+            rest = refs[6:]
+        else:
+            pw_ref, wt_ref, lab_ref, msk_ref = refs[:4]
+            rest = refs[4:]
+        if handle is not None:
+            (wp_ref, zp_ref, np_ref, mg_ref, wo_ref, zo_ref, no_ref,
+             dual_s) = rest
+        else:
+            mg_ref, g_ref, dual_s = rest
+        t = pl.program_id(0)
+
+        @pl.when(t < nt)
+        def _fwd():
+            _fwd_kernel(spec, pw_ref, wt_ref, mg_ref, t)
+
+        @pl.when(t == nt)
+        def _dual():
+            lab = lab_ref[...]
+            msk = msk_ref[...]
+            dual = dual_fn(mg_ref[...], lab, msk)
+            if not exact_dense:
+                # _nudge_zero_dual (learners/store.py), elementwise —
+                # same bits as the split path's XLA nudge
+                eps = jnp.where(lab > 0.5, jnp.float32(-1e-30),
+                                jnp.float32(1e-30))
+                dual = jnp.where((dual == 0.0) & (msk > 0), eps, dual)
+            dual_s[...] = dual.reshape(dual_s.shape).astype(jnp.bfloat16)
+
+        @pl.when(t >= nt)
+        def _bwd():
+            if handle is None:
+                if K > 1:
+                    _bwd_kernel_fused(spec, pwk_ref, dual_s, ghic_ref,
+                                      g_ref)
+                else:
+                    _bwd_kernel(spec, pw_ref, dual_s, g_ref)
+                return
+            sink = _GradSink()
+            if K > 1:
+                _bwd_kernel_fused(spec, pwk_ref, dual_s, ghic_ref, sink)
+            else:
+                _bwd_kernel(spec, pw_ref, dual_s, sink)
+            one = opaque_one(msk_ref[0, 0, 0])
+            for tb in range(spec.tiles_step):
+                w_new, z_new, cg_new = handle.update(
+                    wp_ref[tb], zp_ref[tb], np_ref[tb],
+                    sink.tiles[tb], one)
+                wo_ref[tb] = w_new
+                zo_ref[tb] = z_new
+                no_ref[tb] = cg_new
+
+    return kernel
+
+
+def _step_grid_specs(spec: TileSpec):
+    """(grid, in_specs, nt) shared by both fused scalar variants: pairs
+    + bf16 weight tiles stream through phase 1 (and, at K == 1, phase 2
+    re-streams the pairs exactly as the split bwd call would), the
+    label/mask grids sit at a constant index, and the K > 1 variant
+    adds the re-viewed pairs + the joint-digit compare constant for
+    _bwd_kernel_fused."""
+    T, TB, K = spec.tiles, spec.tiles_step, spec.fuse
+    SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+    GS = spec.group
+    nt = T // TB
+    pw_map = ((lambda t: (jnp.minimum(t, nt - 1), 0, 0)) if K > 1
+              else (lambda t: (t % nt, 0, 0)))
+    in_specs = [
+        pl.BlockSpec((TB, SG, N), pw_map),
+        pl.BlockSpec((TB, A_HI, B_LO),
+                     lambda t: (jnp.minimum(t, nt - 1), 0, 0)),
+        pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+        pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+    ]
+    if K > 1:
+        in_specs += [
+            pl.BlockSpec((TB // K, SG, K * N),
+                         lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
+            pl.BlockSpec((K * N, GS * RH), lambda t: (0, 0)),
+        ]
+    return (2 * nt,), in_specs, nt
+
+
+def _step_dual_scratch(spec: TileSpec):
+    """The VMEM dual-grid scratch, shaped exactly as the split bwd
+    wrapper's XLA reshape of the flat dual — (S//bp, bp*RH, RL) for the
+    paired-subblock kernel, (S//GS, GS*RH, RL) for the K-tile one."""
+    S, GS = spec.subblocks, spec.group
+    if spec.fuse > 1:
+        return pltpu.VMEM((S // GS, GS * RH, RL), jnp.bfloat16)
+    bp = _bp(spec)
+    return pltpu.VMEM((S // bp, bp * RH, RL), jnp.bfloat16)
+
+
+def _step_extra_args(pw, spec: TileSpec):
+    """The K > 1 variant's extra operands (re-viewed pairs + compare
+    constant) — identical to what the split _build_bwd K > 1 wrapper
+    feeds _bwd_kernel_fused."""
+    if spec.fuse <= 1:
+        return []
+    return [_fused_pairs_view(pw, spec),
+            jnp.asarray(_fused_ghi_const(spec.fuse, spec.n, spec.cap,
+                                         spec.group))]
+
+
+@lru_cache(maxsize=None)
+def _build_step_grad(spec: TileSpec, loss: str, exact_dense: bool):
+    """Fused step, grad-emitting variant: (margins, grad) with the dual
+    grid never materialized in HBM. The handle update stays in XLA —
+    the multihost path (gradients cross the wire before the update) and
+    every non-FTRL handle."""
+    T, TB = spec.tiles, spec.tiles_step
+    S = spec.subblocks
+    grid, in_specs, nt = _step_grid_specs(spec)
+    kernel = _make_step_kernel(spec, loss, exact_dense, None, nt)
+
+    @jax.jit
+    def step(pw, w, labels, mask):
+        wt = w.reshape(T, A_HI, B_LO).astype(jnp.bfloat16)
+        args = [pw, wt, labels.reshape(S, RH, RL),
+                mask.reshape(S, RH, RL)] + _step_extra_args(pw, spec)
+        mg, g = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+                pl.BlockSpec((TB, A_HI, B_LO),
+                             lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
+            ],
+            scratch_shapes=[_step_dual_scratch(spec)],
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(*args)
+        return mg.reshape(spec.block_rows), g.reshape(spec.nb)
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def _build_step_update(spec: TileSpec, loss: str, handle):
+    """Fused step, in-place FTRL variant: (margins, new_slots32). The
+    w/z/cg planes enter as operands aliased onto the outputs, so the
+    (nb,) gradient never exists in HBM — each tile's grad goes straight
+    from the bwd accumulator into the elementwise slot update. FTRL is
+    exact-dense (zero_grad_push_is_identity), so there is no nudge and
+    no touched mask to apply. ``handle`` is the (frozen, hashable)
+    FTRLHandle — the kernel runs its update() verbatim."""
+    T, TB = spec.tiles, spec.tiles_step
+    S = spec.subblocks
+    grid, in_specs, nt = _step_grid_specs(spec)
+    kernel = _make_step_kernel(spec, loss, True, handle, nt)
+    n_in = len(in_specs)
+    plane = pl.BlockSpec((TB, A_HI, B_LO),
+                         lambda t: (jnp.maximum(t - nt, 0), 0, 0))
+    in_specs = in_specs + [plane, plane, plane]
+
+    @jax.jit
+    def step(pw, s32, labels, mask):
+        wt = s32[:, 0].reshape(T, A_HI, B_LO).astype(jnp.bfloat16)
+        args = ([pw, wt, labels.reshape(S, RH, RL),
+                 mask.reshape(S, RH, RL)] + _step_extra_args(pw, spec)
+                + [s32[:, 0].reshape(T, A_HI, B_LO),
+                   s32[:, 1].reshape(T, A_HI, B_LO),
+                   s32[:, 2].reshape(T, A_HI, B_LO)])
+        mg, wn, zn, nn = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+                plane, plane, plane,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
+            ],
+            input_output_aliases={n_in: 1, n_in + 1: 2, n_in + 2: 3},
+            scratch_shapes=[_step_dual_scratch(spec)],
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(*args)
+        new = jnp.stack([wn.reshape(spec.nb), zn.reshape(spec.nb),
+                         nn.reshape(spec.nb)], axis=-1)
+        return mg.reshape(spec.block_rows), new
+
+    return step
+
+
+def fm_margin_math(lin, s_parts, q, one):
+    """FM margin lin + ½(Σ s_j² − q), the sum accumulated in fixed
+    sequential order with every product ``*one``-guarded (``one`` =
+    opaque_one(...)) — the fused kernel's boundary phase and the split
+    XLA forward (models/fm.py) both call this, so the margin bits match
+    across contexts regardless of FMA contraction."""
+    ss = (s_parts[0] * s_parts[0]) * one
+    for sj in s_parts[1:]:
+        ss = ss + (sj * sj) * one
+    return lin + (jnp.float32(0.5) * (ss - q)) * one
+
+
+def _make_fm_step_kernel(spec: TileSpec, ch: int, k: int, loss: str,
+                         nt: int):
+    """Two-phase multi-channel kernel body for the FM step: phase 1 is
+    the unmodified _fwd_multi_kernel accumulating the (S, RH, ch*RL)
+    pulls grid in VMEM scratch (it never reaches HBM at all); the
+    boundary computes the FM margin (lin + 0.5*(Σ s_j² − q), summed
+    sequentially — the split path mirrors the same order), the dual,
+    and the [dual, dual*s_j..., mask] push channels; phase 2 is the
+    unmodified _bwd_multi_kernel."""
+    from .loss import create_loss, opaque_one
+    _, dual_fn = create_loss(loss)
+
+    def kernel(pw_ref, wt_ref, lab_ref, msk_ref, mg_ref, push_ref,
+               pulls_s, dual_s):
+        t = pl.program_id(0)
+
+        @pl.when(t < nt)
+        def _fwd():
+            _fwd_multi_kernel(spec, ch, pw_ref, wt_ref, pulls_s, t)
+
+        @pl.when(t == nt)
+        def _dual():
+            pulls = pulls_s[...]                   # (S, RH, ch*RL)
+            msk = msk_ref[...]
+            one = opaque_one(msk[0, 0, 0])
+            s_parts = [pulls[..., (1 + j) * RL:(2 + j) * RL]
+                       for j in range(k)]
+            margin = fm_margin_math(
+                pulls[..., 0:RL], s_parts,
+                pulls[..., (1 + k) * RL:(2 + k) * RL], one)
+            mg_ref[...] = margin
+            dual = dual_fn(margin, lab_ref[...], msk)
+            parts = [dual]
+            for j in range(k):
+                parts.append(dual * pulls[..., (1 + j) * RL:
+                                          (2 + j) * RL])
+            parts.append(msk)                      # touched-count channel
+            dv = jnp.concatenate(parts, axis=-1)   # (S, RH, ch*RL)
+            dual_s[...] = dv.reshape(dual_s.shape).astype(jnp.bfloat16)
+
+        @pl.when(t >= nt)
+        def _bwd():
+            _bwd_multi_kernel(spec, ch, pw_ref, dual_s, push_ref)
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _build_fm_step_fused(spec: TileSpec, k: int, loss: str):
+    ch = k + 2
+    spec = _multi_spec(spec, ch)       # same compile-budget rule as split
+    T, TB = spec.tiles, spec.tiles_step
+    SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+    bp = _bp(spec)
+    nt = T // TB
+    kernel = _make_fm_step_kernel(spec, ch, k, loss, nt)
+
+    @jax.jit
+    def step(pw, wpull, labels, mask):
+        # (nb, ch) -> (T, A_HI, ch*B_LO): channel-major contiguous lanes
+        wt = (wpull.reshape(T, A_HI, B_LO, ch).transpose(0, 1, 3, 2)
+              .reshape(T, A_HI, ch * B_LO).astype(jnp.bfloat16))
+        mg, push = pl.pallas_call(
+            kernel,
+            grid=(2 * nt,),
+            in_specs=[
+                pl.BlockSpec((TB, SG, N), lambda t: (t % nt, 0, 0)),
+                pl.BlockSpec((TB, A_HI, ch * B_LO),
+                             lambda t: (jnp.minimum(t, nt - 1), 0, 0)),
+                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
+                pl.BlockSpec((TB, A_HI, ch * B_LO),
+                             lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, ch * B_LO), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((S, RH, ch * RL), jnp.float32),
+                pltpu.VMEM((S // bp, bp * RH, ch * RL), jnp.bfloat16),
+            ],
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(pw, wt, labels.reshape(S, RH, RL), mask.reshape(S, RH, RL))
+        # (T, A_HI, ch*B_LO) channel-major lanes -> (nb, ch)
+        pushes = (push.reshape(T, A_HI, ch, B_LO).transpose(0, 1, 3, 2)
+                  .reshape(spec.nb, ch))
+        return mg.reshape(spec.block_rows), pushes
+
+    return step
+
+
+# -- fused-step public surface (call inside a jitted step) ------------------
+
+def fused_step_grad(pw: jax.Array, w: jax.Array, labels: jax.Array,
+                    mask: jax.Array, spec: TileSpec, loss: str,
+                    exact_dense: bool) -> Tuple[jax.Array, jax.Array]:
+    """One-grid margins + dual + grad: (margins (block_rows,),
+    grad (nb,)), bitwise-identical to forward_margins -> dual_fn
+    [-> nudge] -> backward_grad with no spill. Callers must have
+    resolved the geometry admissible (resolve_step_kernel)."""
+    return _build_step_grad(spec, loss, exact_dense)(pw, w, labels, mask)
+
+
+def fused_step_update(pw: jax.Array, s32: jax.Array, labels: jax.Array,
+                      mask: jax.Array, spec: TileSpec, loss: str,
+                      handle) -> Tuple[jax.Array, jax.Array]:
+    """One-grid margins + dual + grad + in-place FTRL: (margins,
+    new_slots (nb, 3) f32). ``handle`` is the FTRLHandle whose update()
+    runs in-kernel. The gradient never exists in HBM — single-process
+    only (multihost gradients must cross the wire first; use
+    fused_step_grad)."""
+    return _build_step_update(spec, loss, handle)(pw, s32, labels, mask)
+
+
+def fused_fm_step(pw: jax.Array, wpull: jax.Array, labels: jax.Array,
+                  mask: jax.Array, spec: TileSpec, k: int, loss: str
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """One-grid FM step: (margins (block_rows,), pushes (nb, k+2)) from
+    the (nb, k+2) channel table [w, v_j..., Σv²]. Neither the pulls nor
+    the dual-channel grid touches HBM; the AdaGrad update stays in XLA
+    (it is elementwise over buckets either way)."""
+    return _build_fm_step_fused(spec, k, loss)(pw, wpull, labels, mask)
 
 
 # -- public jit-safe surface (call inside a jitted step) --------------------

@@ -185,6 +185,14 @@ class Config:
     # "off" keeps the existing scatter/dense paths. crec2 files are
     # already tile-grouped and ignore this knob.
     tile_online: str = "auto"
+    # tile train-step kernel (ops/tilemm.py): "fused" runs fwd margins,
+    # loss dual, grad histogram (and the FTRL update in place on the
+    # single-process path) as ONE two-phase pallas grid, so neither the
+    # margin grid nor the (nb,) gradient round-trips HBM; "split" keeps
+    # the two-call fwd/bwd oracle (the bit-parity reference and the
+    # fallback for spill blocks, mesh shards and deep stores); "auto"
+    # fuses on the TPU backend when the geometry admits it.
+    tile_step_kernel: str = "auto"
     # multi-device crec/crec2 feed (data/crec.MeshGroupFeed): "ring"
     # assembles each data-axis group of D blocks on the pipeline prep
     # workers and device_puts it onto its (data, model) NamedSharding

@@ -1397,6 +1397,60 @@ def bench_chaos() -> dict:
     return out
 
 
+def bench_rejoin() -> dict:
+    """Live-rejoin drill (wormhole_tpu/ft/drill.py): kill one of 3
+    in-process ranks mid-pass while an open-loop serve client runs
+    against a hot-swapped snapshot, detect via heartbeat silence,
+    re-queue only the dead rank's shards, and admit a rejoiner through
+    the version-vector handshake + bounded delta replay — survivors
+    never restart. Reported: serve p99 THROUGH the cycle
+    (``rejoin_p99_ms``, gated like the serve phase's tails), recovery
+    debt (detection → admission, ``recovery_debt_s`` — absolute ceiling
+    in scripts/bench_check.py), replayed window count, and final objv
+    vs an undisturbed baseline drill."""
+    from wormhole_tpu.ft.drill import run_rejoin_drill
+
+    workdir = tempfile.mkdtemp(prefix="wh_bench_rejoin_")
+    base = run_rejoin_drill(os.path.join(workdir, "base"), kill=None)
+    out = {"tol_rel": 0.25,
+           "baseline": {"objv": round(base["objv"], 6),
+                        "wall_s": base["wall_s"],
+                        "windows": base["windows"],
+                        "serve_p99_ms": round(
+                            base["serve"]["p99_ms"], 2)}}
+    if _deadline_passed():
+        out["budget_truncated"] = True
+        return out
+    rec = run_rejoin_drill(os.path.join(workdir, "kill"))
+    rj = rec.get("rejoin") or {}
+    objv = rec["objv"]
+    out.update({
+        "world": rec["world"],
+        "windows": rec["windows"],
+        "detect_s": (rec.get("kill") or {}).get("detect_s"),
+        "threads_per_rank": rec["threads_per_rank"],
+        # serve tail THROUGH kill->detect->replay->admit; the _LAT_PAT
+        # suffix puts it under bench_check's latency gate automatically
+        "rejoin_p99_ms": round(rec["serve"]["p99_ms"], 2),
+        "serve_requests": rec["serve"]["requests"],
+        "snapshot_swaps": rec["serve"]["swaps"],
+        "recovery_debt_s": rj.get("recovery_debt_s"),
+        "replayed_windows": rj.get("replayed"),
+        "replay_depth": rec["replay_depth"],
+        "handshake_s": rj.get("handshake_s"),
+        "join_idx": rj.get("join_idx"),
+        "membership_epoch": rj.get("epoch"),
+        "admitted_within_bound": rj.get("admitted_within_bound"),
+        "slots_rel_err": rj.get("slots_rel_err"),
+        "objv": round(objv, 6),
+        "objv_delta_rel": round(
+            abs(objv - base["objv"]) / max(abs(base["objv"]), 1e-9), 4),
+        "wall_s": rec["wall_s"],
+    })
+    out["within_tol"] = out["objv_delta_rel"] <= out["tol_rel"]
+    return out
+
+
 MULTICHIP_ROWS = 163_840     # 10 blocks x 16384 rows (subblocks=2)
 MULTICHIP_WINDOW = 6.0       # timed window per (shape, mode) run
 
@@ -1608,7 +1662,8 @@ PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "tile_fused", "device_sparse",
           "device_dense_apply", "scale_curve", "multichip", "serve",
-          "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt", "chaos"]
+          "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt", "chaos",
+          "rejoin"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1710,6 +1765,8 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
         extra["serve"] = _round_serve(results["serve"])
     if "chaos" in results:
         extra["chaos_recovery"] = results["chaos"]
+    if "rejoin" in results:
+        extra["rejoin"] = results["rejoin"]
     if "comm_filters" in results:
         extra["comm_filters"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
@@ -1854,6 +1911,7 @@ def main(argv=None) -> None:
         "lbfgs": bench_lbfgs,
         "gbdt": bench_gbdt,
         "chaos": bench_chaos,
+        "rejoin": bench_rejoin,
     }
 
     results: dict = {}

@@ -21,6 +21,10 @@ regresses against its predecessor:
   key (the serve phase's tail-latency SLO numbers) must not GROW above
   ``prev * (1 + tol)`` at the same dotted path — a p99 regression gates
   just like a throughput drop, with the inequality flipped.
+- **Recovery debt** (absolute): the NEWEST run's ``*recovery_debt_s``
+  values (rejoin phase: detection → rejoiner admitted) must stay under
+  ``--max-recovery-debt`` — a ceiling, not a trend, because past the
+  drill's group timeout the handshake is dead by definition.
 - **Ledger fractions**: when both runs carry a ledger block (bench.py
   ``--out`` telemetry, ``{"ledger": {"frac": {...}}}`` anywhere under
   ``parsed``), the ``unattributed`` and ``residual_stall`` fractions may
@@ -72,6 +76,7 @@ _RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
 _LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
 _SCALE_PAT = re.compile(r"scaling_efficiency$")
 _FUSED_PAT = re.compile(r"fused_over_split$")
+_DEBT_PAT = re.compile(r"recovery_debt_s$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
 # default --min-scaling: the measured CPU fake-8-device trajectory sits
 # at 0.09-0.13 across the swept shapes (all "devices" share the host
@@ -84,6 +89,13 @@ _MIN_SCALING = 0.05
 # one-grid step exists to beat the two calls it replaces, so < 1.0 is a
 # regression by definition, not a tolerance question
 _MIN_FUSED_RATIO = 1.0
+# absolute ceiling on the newest BENCH run's *recovery_debt_s (bench.py
+# --phases rejoin: heartbeat detection -> rejoiner admitted, dominated
+# on CPU by the rejoiner's checkpoint restore + first-window jit
+# compiles). 60s passes the CPU-host cost with headroom while catching
+# a replay path that wedges into its GroupTimeout (the drill's
+# survivors wait 60s before declaring the handshake dead)
+_MAX_RECOVERY_DEBT = 60.0
 
 
 def load_runs(bench_dir: str,
@@ -259,10 +271,28 @@ def fused_floor(name: str, parsed: dict, min_ratio: float) -> List[str]:
         if v < min_ratio]
 
 
+def debt_keys(parsed: dict) -> Dict[str, float]:
+    """``*recovery_debt_s`` keys (rejoin phase)."""
+    return _keys_matching(parsed, _DEBT_PAT)
+
+
+def debt_ceiling(name: str, parsed: dict, max_debt: float) -> List[str]:
+    """Absolute ceiling on the newest run's rejoin recovery debt: a
+    run-to-run relative gate would ratchet along with a slowly
+    regressing replay path, and the quantity has a hard meaning — past
+    the drill's group timeout the survivors give the rejoiner up."""
+    return [
+        f"{key}: {v:.1f}s > --max-recovery-debt {max_debt:.1f}s "
+        f"({name}) — rejoin recovery debt above the absolute ceiling"
+        for key, v in sorted(debt_keys(parsed).items())
+        if v > max_debt]
+
+
 def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      tol_frac: float, all_pairs: bool,
-                     min_scaling: float,
-                     min_fused_ratio: float) -> Tuple[List[str], int, int]:
+                     min_scaling: float, min_fused_ratio: float,
+                     max_recovery_debt: float) -> Tuple[List[str], int,
+                                                        int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
             if p is not None]
@@ -271,6 +301,7 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
         failures.extend(scaling_floor(*runs[-1], min_scaling))
     if prefix == "BENCH" and runs:
         failures.extend(fused_floor(*runs[-1], min_fused_ratio))
+        failures.extend(debt_ceiling(*runs[-1], max_recovery_debt))
     if len(runs) < 2:
         print(f"bench_check: {len(runs)} usable {prefix} run(s) under "
               f"{bench_dir!r}; nothing to gate pairwise")
@@ -287,13 +318,14 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
 
 def run(bench_dir: str, tol: float, tol_frac: float,
         all_pairs: bool = False, min_scaling: float = _MIN_SCALING,
-        min_fused_ratio: float = _MIN_FUSED_RATIO) -> int:
+        min_fused_ratio: float = _MIN_FUSED_RATIO,
+        max_recovery_debt: float = _MAX_RECOVERY_DEBT) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
         f, p, c = _gate_trajectory(prefix, bench_dir, tol, tol_frac,
                                    all_pairs, min_scaling,
-                                   min_fused_ratio)
+                                   min_fused_ratio, max_recovery_debt)
         failures.extend(f)
         pairs += p
         compared += c
@@ -332,13 +364,20 @@ def main(argv=None) -> int:
                          "*fused_over_split ratio (default "
                          f"{_MIN_FUSED_RATIO}; the fused step must not "
                          "be slower than the split oracle)")
+    ap.add_argument("--max-recovery-debt", type=float,
+                    default=_MAX_RECOVERY_DEBT,
+                    help="absolute ceiling (seconds) on the newest "
+                         "BENCH run's *recovery_debt_s (default "
+                         f"{_MAX_RECOVERY_DEBT}; rejoin phase, "
+                         "detection -> admission)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
     args = ap.parse_args(argv)
     return run(args.dir, args.tol, args.tol_frac,
                all_pairs=args.all_pairs, min_scaling=args.min_scaling,
-               min_fused_ratio=args.min_fused_ratio)
+               min_fused_ratio=args.min_fused_ratio,
+               max_recovery_debt=args.max_recovery_debt)
 
 
 if __name__ == "__main__":

@@ -282,3 +282,42 @@ def test_multichip_dryrun_snapshots_skipped_and_bridge(tmp_path):
     # a drop across the bridge still fails
     _write_mc(d, 3, _mc_parsed(40_000.0, 91_000.0, eff=0.12))
     assert _run("--dir", d).returncode == 1
+
+
+def test_recovery_debt_ceiling_gates_newest_run(tmp_path):
+    """*recovery_debt_s is an absolute ceiling on the newest run only —
+    a single run is enough to trip it (no pair needed), and the flag
+    relaxes it."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"rejoin": {"recovery_debt_s": 99.5,
+                                         "rejoin_p99_ms": 40.0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "recovery_debt_s" in r.stderr
+    assert "--max-recovery-debt" in r.stderr
+    r2 = _run("--dir", d, "--max-recovery-debt", "200")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_recovery_debt_under_ceiling_passes(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"rejoin": {"recovery_debt_s": 1.2}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"rejoin": {"recovery_debt_s": 8.0}}))
+    # growth within the ceiling is NOT a regression (absolute gate,
+    # deliberately not trend-gated — see debt_ceiling's docstring)
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_rejoin_p99_trend_gated_like_serve_latency(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"rejoin": {"rejoin_p99_ms": 50.0}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"rejoin": {"rejoin_p99_ms": 80.0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "rejoin.rejoin_p99_ms" in r.stderr

@@ -1,0 +1,396 @@
+"""Live rank rejoin (wormhole_tpu/ft/rejoin.py): version vectors,
+bounded replay, membership group, handshake, chaos knobs, torn-read
+checkpoint scans, and the launcher's per-rank respawn path. The full
+kill-and-rejoin drill under serving traffic is the slow e2e
+(test_ft_rejoin_e2e.py)."""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.ft.rejoin import (DeadMember, LocalGroup,
+                                    RejoinHandshake, ReplayExhausted,
+                                    ReplayLog, VersionVector)
+
+from tests.test_launcher_mp import run_mp
+
+
+# -- version vector ------------------------------------------------------
+
+
+def test_vv_one_hot_sum_reconstructs():
+    # the wire trick: each rank ships its own counter one-hot; the
+    # delta allreduce's sum IS the full vector
+    vvs = [VersionVector(3) for _ in range(3)]
+    for r, vv in enumerate(vvs):
+        vv.bump(r, r + 1)
+    reduced = sum(vv.one_hot(r) for r, vv in enumerate(vvs))
+    np.testing.assert_array_equal(reduced, [1, 2, 3])
+    mine = VersionVector(3)
+    mine.merge_row(reduced)
+    assert mine.counts == [1, 2, 3]
+
+
+def test_vv_merge_is_elementwise_max():
+    a = VersionVector(3)
+    a.merge_row([5, 0, 2])
+    a.merge_row([3, 4, 1])          # stale row must not regress slot 0
+    assert a.counts == [5, 4, 2]
+    assert a.lag(1) == 1
+    b = VersionVector(3)
+    b.bump(2, 9)
+    a.merge(b)
+    assert a.counts == [5, 4, 9]
+
+
+def test_vv_world_validation():
+    with pytest.raises(ValueError):
+        VersionVector(0)
+
+
+# -- replay log ----------------------------------------------------------
+
+
+def test_replay_record_fetch_window():
+    log = ReplayLog(depth=8)
+    for i in range(5):
+        log.record(i, {"grad": i})
+    assert log.oldest() == 0 and log.latest() == 4
+    got = log.fetch(1, 3)
+    assert [i for i, _ in got] == [2, 3]
+    assert log.fetch(4, 4) == []     # nothing missed -> empty
+
+
+def test_replay_eviction_raises_exhausted():
+    log = ReplayLog(depth=3)
+    for i in range(10):              # windows 0..6 evicted
+        log.record(i, i)
+    assert log.evicted == 7
+    assert log.oldest() == 7
+    with pytest.raises(ReplayExhausted):
+        log.fetch(2, 9)
+    # a gap the log still covers is fine
+    assert [i for i, _ in log.fetch(6, 9)] == [7, 8, 9]
+
+
+def test_replay_fetch_waits_for_late_record():
+    # the reduce->record race: the group reduced window 2 but the
+    # survivor's drain thread hasn't recorded it yet — fetch blocks
+    log = ReplayLog(depth=8)
+    log.record(0, 0)
+
+    def late():
+        time.sleep(0.05)
+        log.record(1, 1)
+        log.record(2, 2)
+
+    t = threading.Thread(target=late)
+    t.start()
+    got = log.fetch(0, 2, timeout=5.0)
+    t.join()
+    assert [i for i, _ in got] == [1, 2]
+
+
+def test_replay_fetch_timeout():
+    log = ReplayLog(depth=4)
+    log.record(0, 0)
+    with pytest.raises(TimeoutError):
+        log.fetch(0, 5, timeout=0.05)
+
+
+def test_replay_depth_validation():
+    with pytest.raises(ValueError):
+        ReplayLog(0)
+
+
+# -- local membership group ----------------------------------------------
+
+
+def _reduce_on_thread(group, rank, idx, payload, out):
+    def run():
+        try:
+            out[rank] = group.allreduce(rank, idx, payload, timeout=10)
+        except BaseException as e:
+            out[rank] = e
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_group_allreduce_sums_all_ranks():
+    g = LocalGroup(3)
+    out = {}
+    ts = [_reduce_on_thread(g, r, 0, {"x": np.float32(r + 1)}, out)
+          for r in range(3)]
+    for t in ts:
+        t.join(timeout=10)
+    assert all(float(out[r]["x"]) == 6.0 for r in range(3))
+
+
+def test_group_mark_dead_unblocks_inflight_window():
+    g = LocalGroup(3)
+    out = {}
+    ts = [_reduce_on_thread(g, r, 0, {"x": np.float32(1)}, out)
+          for r in (0, 1)]           # rank 2 never posts
+    time.sleep(0.05)
+    assert g.mark_dead(2) == 1       # epoch bumped
+    for t in ts:
+        t.join(timeout=10)
+    # window reduced over the live sub-group
+    assert all(float(out[r]["x"]) == 2.0 for r in (0, 1))
+    with pytest.raises(DeadMember):
+        g.allreduce(2, 1, {"x": np.float32(1)})
+
+
+def test_group_dead_ranks_posted_bytes_stay_in():
+    # a contribution already on the wire when the rank died is included
+    g = LocalGroup(3)
+    out = {}
+    t2 = _reduce_on_thread(g, 2, 0, {"x": np.float32(10)}, out)
+    time.sleep(0.05)
+    g.mark_dead(2)
+    ts = [_reduce_on_thread(g, r, 0, {"x": np.float32(1)}, out)
+          for r in (0, 1)]
+    for t in ts + [t2]:
+        t.join(timeout=10)
+    assert float(out[0]["x"]) == 12.0
+
+
+def test_group_attach_reserves_next_boundary():
+    g = LocalGroup(2)
+    out = {}
+    for idx in range(3):
+        ts = [_reduce_on_thread(g, r, idx, {"x": np.float32(1)}, out)
+              for r in (0, 1)]
+        for t in ts:
+            t.join(timeout=10)
+    g.detach(1)                      # graceful: no epoch bump
+    assert g.epoch == 0
+    join = g.attach(1)
+    assert join == 3 and g.epoch == 1
+    # window 3 now waits for the rejoiner's contribution
+    out3 = {}
+    t0 = _reduce_on_thread(g, 0, 3, {"x": np.float32(1)}, out3)
+    time.sleep(0.05)
+    assert 0 not in out3
+    t1 = _reduce_on_thread(g, 1, 3, {"x": np.float32(5)}, out3)
+    for t in (t0, t1):
+        t.join(timeout=10)
+    assert float(out3[0]["x"]) == 6.0
+
+
+def test_handshake_attach_then_replay_in_order():
+    g = LocalGroup(2)
+    log = ReplayLog(depth=8)
+    out = {}
+    for idx in range(4):             # survivor 0 reduced windows 0..3
+        ts = [_reduce_on_thread(g, r, idx, {"x": np.float32(r)}, out)
+              for r in (0, 1)]
+        for t in ts:
+            t.join(timeout=10)
+        log.record(idx, {"x": np.float32(idx)})
+    g.mark_dead(1)
+    applied = []
+    rep = RejoinHandshake(g, log).run(1, have_idx=0,
+                                      apply_fn=lambda i, p:
+                                      applied.append(i))
+    assert rep.join_idx == 4 and rep.replayed == 3
+    assert applied == [1, 2, 3]      # ordered, (have, join) exclusive
+    assert rep.epoch == g.epoch and 1 in g.live()
+
+
+# -- engine records reduced windows into the replay log ------------------
+
+
+def test_engine_records_successful_deltas_only():
+    from wormhole_tpu.ps.engine import ExchangeEngine
+    log = ReplayLog(depth=8)
+    eng = ExchangeEngine(0, replay=log)
+    try:
+        for i in range(3):
+            t = eng.submit(lambda i=i: {"grad": i})
+            eng.gate()
+            assert t.result == {"grad": i}
+        bad = eng.submit(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        with pytest.raises(RuntimeError):
+            eng.gate()
+        assert bad.error is not None
+    finally:
+        eng.stop()
+    assert [i for i, _ in log.fetch(-1, 2)] == [0, 1, 2]
+    assert log.latest() == 2         # the failed window was not recorded
+
+
+def test_replay_depth_and_build_engine_wiring():
+    from wormhole_tpu.ps.config import build_engine, replay_depth
+    from wormhole_tpu.utils.config import Config
+    assert replay_depth(Config(staleness_tau=2)) == 0   # knob off
+    assert replay_depth(Config(staleness_tau=2,
+                               rejoin_replay_windows=3)) == 5
+    assert replay_depth(Config(staleness_tau=-1,
+                               rejoin_replay_windows=3)) == 3
+    eng = build_engine(Config(staleness_tau=1))
+    try:
+        assert eng.replay is None    # off by default: wire bytes and
+    finally:                         # tau=0 BSP parity untouched
+        eng.stop()
+    eng = build_engine(Config(staleness_tau=1, rejoin_replay_windows=4))
+    try:
+        assert eng.replay is not None and eng.replay.depth == 5
+    finally:
+        eng.stop()
+
+
+def test_rejoin_metrics_declared_once():
+    from wormhole_tpu.obs.metrics import Registry
+    from wormhole_tpu.ps.telemetry import rejoin_metrics
+    met = rejoin_metrics(Registry())
+    met.epoch.set(2)
+    met.replayed.inc(5)
+    assert met.epoch.value == 2 and met.replayed.value == 5
+
+
+# -- chaos knobs ---------------------------------------------------------
+
+
+def test_chaos_rejoin_handshake_delay():
+    from wormhole_tpu.ft import chaos
+    try:
+        assert chaos.install({"rejoin_handshake_delay": 0.08}, rank=0)
+        t0 = time.monotonic()
+        chaos.on_rejoin_handshake()
+        assert time.monotonic() - t0 >= 0.08
+    finally:
+        chaos.reset()
+    t0 = time.monotonic()
+    chaos.on_rejoin_handshake()      # disarmed -> no sleep
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_chaos_rejoin_knobs_from_config():
+    from wormhole_tpu.ft import chaos
+    from wormhole_tpu.utils.config import Config
+    cfg = Config(chaos_rejoin_handshake_delay_s=0.01,
+                 chaos_rejoin_ckpt_transient=2)
+    try:
+        assert chaos.install_from_config(cfg, rank=0)
+        with pytest.raises(OSError):
+            chaos.rejoin_ckpt_fault("/some/dir")
+        with pytest.raises(OSError):
+            chaos.rejoin_ckpt_fault("/some/dir")
+        chaos.rejoin_ckpt_fault("/some/dir")   # budget spent
+    finally:
+        chaos.reset()
+
+
+def test_latest_version_retries_torn_scan(tmp_path):
+    from wormhole_tpu.ft import chaos
+    from wormhole_tpu.parallel.checkpoint import Checkpointer
+    ck = Checkpointer(str(tmp_path))
+    ck.save(3, {"w": np.ones(4, np.float32)})
+    try:
+        chaos.install({"rejoin_ckpt_transient": 1}, rank=0)
+        assert ck.latest_version() == 3       # one fault -> one retry
+        chaos.install({"rejoin_ckpt_transient": 2}, rank=0)
+        with pytest.raises(OSError):          # second fault propagates
+            ck.latest_version()
+    finally:
+        chaos.reset()
+    assert ck.latest_version() == 3
+
+
+def test_shard_latest_version_retries_torn_scan(tmp_path):
+    from wormhole_tpu.ft import chaos
+    from wormhole_tpu.parallel.checkpoint import ShardCheckpointer
+    ck = ShardCheckpointer(str(tmp_path), rank=0, world=1)
+    ck.save(2, {"w": np.ones(4, np.float32)}, barrier=False)
+    try:
+        chaos.install({"rejoin_ckpt_transient": 1}, rank=0)
+        assert ck.latest_version() == 2
+        chaos.install({"rejoin_ckpt_transient": 2}, rank=0)
+        with pytest.raises(OSError):
+            ck.latest_version()
+    finally:
+        chaos.reset()
+    assert ck.latest_version() == 2
+
+
+def test_shard_checkpointer_rank_override(tmp_path):
+    # the drill's simulated ranks and the rejoiner's cross-instance
+    # restore both need rank/world without jax.distributed
+    from wormhole_tpu.parallel.checkpoint import ShardCheckpointer
+    w = ShardCheckpointer(str(tmp_path), rank=2, world=3)
+    w.save(4, {"w": np.full(4, 7, np.float32)}, barrier=False)
+    r = ShardCheckpointer(str(tmp_path), rank=2, world=3)
+    ver, st = r.load({"w": np.zeros(4, np.float32)})
+    assert ver == 4
+    np.testing.assert_array_equal(st["w"], np.full(4, 7, np.float32))
+
+
+# -- supervisor + launcher respawn path ----------------------------------
+
+
+def test_supervisor_rejoin_bookkeeping():
+    from wormhole_tpu.ft.supervisor import Supervisor
+    sup = Supervisor(3, elastic="rejoin", dead_after_s=1.0)
+    assert sup.next_world() == 3
+    sup.record_exit(1, 9)
+    assert sup.dead == {1} and sup.epoch == 1
+    assert sup.rejoinable(1) and not sup.rejoinable(0)
+    assert sup.note_rejoined(1) == 2
+    assert sup.dead == set() and 1 not in sup.exit_codes
+    sup2 = Supervisor(3, elastic="shrink")
+    sup2.record_exit(1, 9)
+    assert not sup2.rejoinable(1)    # shrink keeps stop-the-world
+
+
+def test_launcher_live_rejoin_no_world_relaunch():
+    """rank 1 crashes on attempt 0; the launcher respawns ONLY rank 1
+    into the live world (attempt dir unchanged, survivors' processes
+    keep running) and the job exits clean."""
+    mark = tempfile.mkdtemp(prefix="wh_rejoin_mark_")
+    r = run_mp(3, f"""
+        import os, sys, time
+        rank = int(os.environ["PROCESS_ID"])
+        attempt = int(os.environ.get("WORMHOLE_ATTEMPT", "0"))
+        mark = {mark!r}
+        if rank == 1 and attempt == 0:
+            sys.exit(7)                    # simulated crash
+        if rank == 1:
+            # the respawn must carry the rejoin env contract
+            assert os.environ.get("WORMHOLE_REJOIN_RANK") == "1"
+            with open(os.path.join(mark, "rejoined"), "w") as f:
+                f.write(str(attempt))
+            sys.exit(0)
+        with open(os.path.join(mark, f"pid{{rank}}"), "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(2.0)                    # outlive the respawn cycle
+        """, launcher_args=("--ft-elastic", "rejoin", "--restarts", "1"),
+        raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "live rejoin" in r.stderr and "survivors keep running" \
+        in r.stderr, r.stderr
+    assert "rejoined (membership epoch" in r.stderr, r.stderr
+    # no stop-the-world: the whole-world relaunch banner never printed
+    assert "supervised relaunch" not in r.stderr, r.stderr
+    with open(os.path.join(mark, "rejoined")) as f:
+        assert f.read() == "1"             # respawn ran as attempt 1
+    assert sorted(os.listdir(mark)) == ["pid0", "pid2", "rejoined"]
+
+
+def test_launcher_rejoin_budget_exhausted_fails_job():
+    r = run_mp(3, """
+        import os, sys, time
+        rank = int(os.environ["PROCESS_ID"])
+        if rank == 1:
+            sys.exit(7)                    # crashes on EVERY attempt
+        time.sleep(2.0)
+        """, launcher_args=("--ft-elastic", "rejoin", "--restarts", "1"),
+        raw=True)
+    assert r.returncode == 7, r.stdout + r.stderr
+    assert r.stderr.count("live rejoin") == 1, r.stderr

@@ -17,6 +17,14 @@ called from the hot paths they disturb:
 - :func:`ckpt_fault` — the checkpoint commit helper: raise ``OSError``
   for the first ``ckpt_errors`` commits (transient IO blip; the commit
   path retries once).
+- :func:`on_rejoin_handshake` — the live-rejoin handshake
+  (ft/rejoin.py): sleep ``rejoin_handshake_delay`` seconds before the
+  rejoiner attaches (a slow relaunch; stretches the replay gap the
+  bounded log must cover).
+- :func:`rejoin_ckpt_fault` — the ``latest_version`` scan on the
+  rejoin load path: raise ``OSError`` for the first
+  ``rejoin_ckpt_transient`` scans (a torn read racing a concurrent
+  save mid-rename; the scan retries once).
 
 Faults fire only on attempt 0 (``WORMHOLE_ATTEMPT``, exported by the
 launcher on every launch): the injection run takes the fault, the
@@ -42,12 +50,15 @@ _DEFAULTS: Dict[str, Any] = {
     "collective_delay_s": 0.0,
     "heartbeat_delay_s": 0.0,
     "ckpt_errors": 0,
+    "rejoin_handshake_delay": 0.0,
+    "rejoin_ckpt_transient": 0,
 }
 
 _PLAN: Optional[Dict[str, Any]] = None
 _RANK = -1
 _BLOCKS = 0
 _CKPT_FAULTS = 0
+_REJOIN_CKPT_FAULTS = 0
 
 
 def current_attempt() -> int:
@@ -77,7 +88,7 @@ def install(plan: Dict[str, Any], rank: int) -> bool:
     Inert plans (all defaults), non-zero attempts, and unknown keys all
     resolve to "no plan": the hooks then cost one global load.
     """
-    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS
+    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS, _REJOIN_CKPT_FAULTS
     merged = dict(_DEFAULTS)
     merged.update(_env_plan())
     merged.update({k: v for k, v in plan.items() if k in _DEFAULTS})
@@ -86,6 +97,7 @@ def install(plan: Dict[str, Any], rank: int) -> bool:
     _RANK = int(rank)
     _BLOCKS = 0
     _CKPT_FAULTS = 0
+    _REJOIN_CKPT_FAULTS = 0
     return armed
 
 
@@ -97,13 +109,18 @@ def install_from_config(cfg: Any, rank: int) -> bool:
         "collective_delay_s": getattr(cfg, "chaos_collective_delay_s", 0.0),
         "heartbeat_delay_s": getattr(cfg, "chaos_heartbeat_delay_s", 0.0),
         "ckpt_errors": getattr(cfg, "chaos_ckpt_errors", 0),
+        "rejoin_handshake_delay":
+            getattr(cfg, "chaos_rejoin_handshake_delay_s", 0.0),
+        "rejoin_ckpt_transient":
+            getattr(cfg, "chaos_rejoin_ckpt_transient", 0),
     }, rank)
 
 
 def reset() -> None:
     """Drop any installed plan (test teardown)."""
-    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS
+    global _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS, _REJOIN_CKPT_FAULTS
     _PLAN, _RANK, _BLOCKS, _CKPT_FAULTS = None, -1, 0, 0
+    _REJOIN_CKPT_FAULTS = 0
 
 
 def active() -> bool:
@@ -148,3 +165,25 @@ def ckpt_fault(path: str) -> None:
     raise OSError(
         f"chaos: injected transient checkpoint IO error "
         f"#{_CKPT_FAULTS} ({path})")
+
+
+def on_rejoin_handshake() -> None:
+    """Stall the rejoin handshake (slow relaunch / long detection):
+    unlike the other delay hooks this is not gated on ``delay_rank`` —
+    the rejoiner IS the rank of interest by construction."""
+    p = _PLAN
+    if p is not None and p["rejoin_handshake_delay"] > 0:
+        time.sleep(p["rejoin_handshake_delay"])
+
+
+def rejoin_ckpt_fault(path: str) -> None:
+    """Raise a transient OSError for the first ``rejoin_ckpt_transient``
+    version scans (torn directory read racing a concurrent save)."""
+    global _REJOIN_CKPT_FAULTS
+    p = _PLAN
+    if p is None or _REJOIN_CKPT_FAULTS >= p["rejoin_ckpt_transient"]:
+        return
+    _REJOIN_CKPT_FAULTS += 1
+    raise OSError(
+        f"chaos: injected torn version scan "
+        f"#{_REJOIN_CKPT_FAULTS} ({path})")

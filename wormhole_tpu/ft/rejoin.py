@@ -1,0 +1,367 @@
+"""Live rank rejoin: version vectors, bounded delta replay, membership.
+
+PR 8's recovery story is stop-the-world: watchdog → SIGTERM drain →
+supervised relaunch of *everyone* with world-size resharding. The
+reference's ps-lite model is cheaper — servers keep state, surviving
+workers keep pushing/pulling, and a replacement worker picks up
+re-queued shards. This module closes that gap on top of the
+bounded-staleness engine (wormhole_tpu/ps/):
+
+- :class:`VersionVector` — per-rank counters of delta windows submitted
+  to the collective. Each rank piggybacks a one-hot row (its own count
+  in its own slot) on the existing ``ps/delta`` payload, so the
+  sum-allreduce reconstructs the full vector at zero extra collectives
+  — the same trick PR 9 used for pass metrics. Merging is elementwise
+  max, so stale rows (a rejoiner's checkpointed vector) never regress
+  live counters.
+
+- :class:`ReplayLog` — bounded ring of reduced delta windows, recorded
+  by the engine drain thread right after each exchange completes. A
+  rejoiner that checkpointed through window ``v`` fetches windows
+  ``(v, join)`` from any survivor's log and applies them before
+  admission. Depth is ``max(staleness_tau, 0) + rejoin_replay_windows``
+  — the tau term covers windows that were in flight when the
+  checkpoint was cut, the knob covers detection + relaunch latency.
+  A gap past the log's oldest entry raises :class:`ReplayExhausted`:
+  the rank fell too far behind to catch up from deltas and must take
+  the stop-the-world shrink path instead (the decision table in
+  docs/fault_tolerance.md).
+
+- :class:`LocalGroup` — an in-process collective group with live
+  membership and epochs. jax.distributed cannot rebuild a coordinator
+  or re-admit a process today, so the drill fakes the sub-group
+  degrade in-process exactly as the multichip phase fakes devices:
+  N rank threads allreduce through one condition variable, and
+  :meth:`LocalGroup.mark_dead` bumps the membership epoch and lets
+  every in-flight window reduce over the live sub-group.
+  :meth:`LocalGroup.attach` admits a rejoiner atomically at the next
+  window boundary. The class is the reference semantics the real
+  transport will adopt when the runtime grows coordinator rebuild.
+
+- :class:`RejoinHandshake` — the rejoin protocol driver: chaos-able
+  handshake delay, atomic attach (reserving the admission boundary
+  BEFORE replay, so survivors' next window waits for the rejoiner
+  instead of racing it), then bounded replay of the missed reduced
+  deltas into the restored store.
+
+Heavy deps (numpy) are imported lazily so the module stays importable
+from the stdlib-only ft/ package surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from wormhole_tpu.ft import chaos as _chaos
+
+__all__ = [
+    "VersionVector", "ReplayLog", "ReplayExhausted", "LocalGroup",
+    "DeadMember", "GroupTimeout", "RejoinHandshake", "RejoinReport",
+]
+
+
+class VersionVector:
+    """Per-rank window counters; merge is elementwise max.
+
+    ``counts[r]`` = delta windows rank ``r`` has submitted to the
+    collective. The wire form is a one-hot int64 row per rank (own
+    count in own slot) summed by the existing delta allreduce — see
+    :meth:`one_hot` — so reconstructing the global vector costs no
+    extra collective and no extra wire bytes when rejoin is off (the
+    row is only attached when a replay log is live).
+    """
+
+    def __init__(self, world: int) -> None:
+        if world < 1:
+            raise ValueError(f"world={world} < 1")
+        self.counts: List[int] = [0] * int(world)
+
+    @property
+    def world(self) -> int:
+        return len(self.counts)
+
+    def bump(self, rank: int, n: int = 1) -> None:
+        self.counts[rank] += int(n)
+
+    def one_hot(self, rank: int):
+        """This rank's wire row: its counter in its slot, zeros elsewhere
+        (sum-allreduce of all ranks' rows = the full vector)."""
+        import numpy as np
+        row = np.zeros(self.world, np.int64)
+        row[rank] = self.counts[rank]
+        return row
+
+    def merge_row(self, row) -> None:
+        """Fold a reduced wire row (or another vector's counts) in;
+        elementwise max, so replayed/stale rows never regress."""
+        for r, v in enumerate(row):
+            v = int(v)
+            if v > self.counts[r]:
+                self.counts[r] = v
+
+    def merge(self, other: "VersionVector") -> None:
+        self.merge_row(other.counts)
+
+    def lag(self, rank: int) -> int:
+        """Windows ``rank`` is behind the most advanced rank."""
+        return max(self.counts) - self.counts[rank]
+
+    def __repr__(self) -> str:  # debug/log lines
+        return f"VersionVector({self.counts})"
+
+
+class ReplayExhausted(RuntimeError):
+    """The replay log no longer covers the rejoiner's gap: the rank is
+    more than ``depth`` windows behind and must recover via the
+    stop-the-world path (checkpoint restore + full relaunch)."""
+
+
+class ReplayLog:
+    """Bounded ring of reduced delta windows, oldest evicted first.
+
+    ``record`` is called from the engine drain thread (one writer);
+    ``fetch`` from a rejoiner thread (readers) — a condition variable
+    covers both and absorbs the reduce→record race: a window that the
+    group has reduced but the survivor's drain thread has not yet
+    recorded is simply waited for.
+    """
+
+    def __init__(self, depth: int) -> None:
+        if depth < 1:
+            raise ValueError(f"replay depth={depth} < 1")
+        self.depth = int(depth)
+        self.evicted = 0
+        self._cv = threading.Condition()
+        self._entries: deque = deque()  # (window index, reduced payload)
+
+    def record(self, index: int, payload: Any) -> None:
+        with self._cv:
+            self._entries.append((int(index), payload))
+            while len(self._entries) > self.depth:
+                self._entries.popleft()
+                self.evicted += 1
+            self._cv.notify_all()
+
+    def latest(self) -> int:
+        with self._cv:
+            return self._entries[-1][0] if self._entries else -1
+
+    def oldest(self) -> int:
+        with self._cv:
+            return self._entries[0][0] if self._entries else -1
+
+    def fetch(self, have_idx: int, through_idx: int,
+              timeout: float = 60.0) -> List[Tuple[int, Any]]:
+        """All reduced windows ``have_idx < i <= through_idx``, blocking
+        until the log has recorded through ``through_idx``.
+
+        Raises :class:`ReplayExhausted` when eviction already dropped
+        part of the gap, ``TimeoutError`` when the log never catches up
+        (survivors wedged).
+        """
+        if through_idx <= have_idx:
+            return []
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._entries or self._entries[-1][0] < through_idx:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    have = self._entries[-1][0] if self._entries else -1
+                    raise TimeoutError(
+                        f"replay log stuck at window {have} waiting "
+                        f"for {through_idx}")
+            if self._entries[0][0] > have_idx + 1:
+                raise ReplayExhausted(
+                    f"need windows ({have_idx}, {through_idx}] but log "
+                    f"starts at {self._entries[0][0]} (depth {self.depth}, "
+                    f"{self.evicted} evicted): rank too far behind for "
+                    "delta replay; take the shrink/relaunch path")
+            return [(i, p) for i, p in self._entries
+                    if have_idx < i <= through_idx]
+
+
+class DeadMember(RuntimeError):
+    """A rank that was marked dead tried to use the group."""
+
+
+class GroupTimeout(RuntimeError):
+    """An allreduce waited past its deadline (peers wedged or the
+    supervisor never routed around a dead contributor)."""
+
+
+class LocalGroup:
+    """In-process collective group with live membership and epochs.
+
+    One condition variable serializes contribution posting, membership
+    changes, and result fan-out. A window ``idx`` reduces once every
+    *expected* contributor has posted, where expected = live ranks whose
+    ``joined`` boundary is ``<= idx`` — so :meth:`mark_dead` (epoch
+    bump) lets an in-flight window complete over the live sub-group,
+    and a rejoiner admitted at boundary ``j`` is only awaited from
+    window ``j`` on. A dead rank's already-posted contribution stays in
+    the reduction (its bytes were on the wire), matching the semantics
+    the real transport would give.
+    """
+
+    # reduced results kept behind the frontier for late gate readers
+    KEEP = 128
+
+    def __init__(self, world: int) -> None:
+        self.world = int(world)
+        self.epoch = 0
+        self._cv = threading.Condition()
+        self._live: Set[int] = set(range(world))
+        self._joined: Dict[int, int] = {r: 0 for r in range(world)}
+        self._contrib: Dict[int, Dict[int, Any]] = {}
+        self._results: Dict[int, Any] = {}
+        self._hi = -1  # highest reduced window index
+
+    # -- membership ---------------------------------------------------
+
+    def live(self) -> Set[int]:
+        with self._cv:
+            return set(self._live)
+
+    def mark_dead(self, rank: int) -> int:
+        """Route around ``rank``: every in-flight and future window
+        reduces over the remaining live set. Returns the new epoch."""
+        with self._cv:
+            if rank in self._live:
+                self._live.discard(rank)
+                self.epoch += 1
+            self._cv.notify_all()
+            return self.epoch
+
+    def detach(self, rank: int) -> None:
+        """Graceful leave at end of pass (no epoch bump — peers have
+        already agreed to stop via the drain protocol)."""
+        with self._cv:
+            self._live.discard(rank)
+            self._cv.notify_all()
+
+    def attach(self, rank: int) -> int:
+        """Admit ``rank`` at the next window boundary; returns its join
+        index. Atomic under the group lock: the boundary is reserved
+        BEFORE the rejoiner replays, so survivors' window ``join`` and
+        later wait for the rejoiner's contribution instead of racing
+        its admission."""
+        with self._cv:
+            join_idx = self._hi + 1
+            self._live.add(rank)
+            self._joined[rank] = join_idx
+            self.epoch += 1
+            self._cv.notify_all()
+            return join_idx
+
+    # -- collective ---------------------------------------------------
+
+    def _expected(self, idx: int) -> Set[int]:
+        return {r for r in self._live if self._joined.get(r, 0) <= idx}
+
+    @staticmethod
+    def _reduce(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k in payloads[0]:
+            acc = payloads[0][k]
+            for p in payloads[1:]:
+                acc = acc + p[k]
+            out[k] = acc
+        return out
+
+    def allreduce(self, rank: int, idx: int, payload: Dict[str, Any],
+                  timeout: float = 60.0) -> Dict[str, Any]:
+        """Sum-reduce ``payload`` with every expected contributor of
+        window ``idx``; every caller gets the same reduced dict."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            if rank not in self._live:
+                raise DeadMember(f"rank {rank} is not a live member")
+            self._contrib.setdefault(idx, {})[rank] = payload
+            while idx not in self._results:
+                have = self._contrib.get(idx, {})
+                if self._expected(idx) <= set(have):
+                    # deterministic reduction order: ascending rank
+                    self._results[idx] = self._reduce(
+                        [have[r] for r in sorted(have)])
+                    self._contrib.pop(idx, None)
+                    if idx > self._hi:
+                        self._hi = idx
+                    for old in [i for i in self._results
+                                if i < self._hi - self.KEEP]:
+                        del self._results[old]
+                    self._cv.notify_all()
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    raise GroupTimeout(
+                        f"window {idx}: rank {rank} waited {timeout:.0f}s "
+                        f"for {sorted(self._expected(idx) - set(have))} "
+                        f"(epoch {self.epoch})")
+            return self._results[idx]
+
+
+class RejoinReport:
+    """What a completed handshake did (drill/bench/test surface)."""
+
+    __slots__ = ("rank", "have_idx", "join_idx", "replayed", "epoch",
+                 "handshake_s")
+
+    def __init__(self, rank: int, have_idx: int, join_idx: int,
+                 replayed: int, epoch: int, handshake_s: float) -> None:
+        self.rank = rank
+        self.have_idx = have_idx
+        self.join_idx = join_idx
+        self.replayed = replayed
+        self.epoch = epoch
+        self.handshake_s = handshake_s
+
+    def __repr__(self) -> str:
+        return (f"RejoinReport(rank={self.rank}, have={self.have_idx}, "
+                f"join={self.join_idx}, replayed={self.replayed}, "
+                f"epoch={self.epoch}, {self.handshake_s * 1e3:.1f}ms)")
+
+
+class RejoinHandshake:
+    """Admit a restored rank: attach at a window boundary, then replay
+    the missed reduced deltas from a survivor's log.
+
+    ``apply_fn(index, payload)`` applies one reduced window to the
+    restored store (the drill closes over ``store.ps_push``); it runs
+    AFTER attach, so by construction every replayed window is ``<``
+    the join boundary and every window ``>=`` it flows through the
+    rejoiner's own engine.
+    """
+
+    def __init__(self, group: LocalGroup, replay: ReplayLog,
+                 metrics=None) -> None:
+        self.group = group
+        self.replay = replay
+        self._metrics = metrics
+
+    def run(self, rank: int, have_idx: int,
+            apply_fn: Callable[[int, Any], None],
+            timeout: float = 60.0) -> RejoinReport:
+        from wormhole_tpu.obs import trace
+        t0 = time.monotonic()
+        with trace.span("rejoin:handshake", cat="ft",
+                        args={"rank": rank, "have": have_idx}):
+            _chaos.on_rejoin_handshake()
+            join_idx = self.group.attach(rank)
+        entries: List[Tuple[int, Any]] = []
+        if join_idx - 1 > have_idx:
+            with trace.span("rejoin:replay", cat="ft",
+                            args={"rank": rank, "have": have_idx,
+                                  "through": join_idx - 1}):
+                entries = self.replay.fetch(have_idx, join_idx - 1,
+                                            timeout=timeout)
+                for idx, payload in entries:
+                    apply_fn(idx, payload)
+        dt = time.monotonic() - t0
+        if self._metrics is not None:
+            self._metrics.replayed.inc(len(entries))
+            self._metrics.epoch.set(self.group.epoch)
+        return RejoinReport(rank, have_idx, join_idx, len(entries),
+                            self.group.epoch, dt)

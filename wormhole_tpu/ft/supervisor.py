@@ -38,6 +38,10 @@ from typing import Dict, Iterable, List, Optional, Set
 from .watchdog import PEER_LOST
 
 DRAIN_ENV = "WORMHOLE_FT_DRAIN"
+# set on a child respawned into a live world (elastic="rejoin"): the
+# learner takes the checkpoint-restore + handshake + replay path
+# instead of a cold start
+REJOIN_ENV = "WORMHOLE_REJOIN_RANK"
 
 # waitpid codes that do NOT mean "this rank caused the failure"
 BYSTANDER_CODES = (0, -signal.SIGTERM, PEER_LOST)
@@ -132,22 +136,28 @@ class Supervisor:
 
     def __init__(self, world: int, elastic: str = "fixed",
                  dead_after_s: float = 0.0) -> None:
-        if elastic not in ("fixed", "shrink"):
-            raise ValueError(f"ft_elastic must be fixed|shrink, got "
-                             f"{elastic!r}")
+        if elastic not in ("fixed", "shrink", "rejoin"):
+            raise ValueError(f"ft_elastic must be fixed|shrink|rejoin, "
+                             f"got {elastic!r}")
         self.world = int(world)
         self.elastic = elastic
         self.detector = DeadRankDetector(dead_after_s)
         self.dead: Set[int] = set()
         self.exit_codes: Dict[int, int] = {}
+        # membership epoch: bumped on every death and every rejoin so
+        # survivors (and telemetry) can order membership changes
+        self.epoch = 0
 
     def record_exit(self, rank: int, code: int) -> None:
         self.exit_codes[rank] = code
         if code not in BYSTANDER_CODES:
             self.dead.add(rank)
+            self.epoch += 1
 
     def record_dead(self, ranks: Iterable[int]) -> None:
-        self.dead.update(int(r) for r in ranks)
+        fresh = {int(r) for r in ranks} - self.dead
+        self.dead.update(fresh)
+        self.epoch += len(fresh)
 
     def scan_heartbeats(self, heartbeat_dir: str,
                         now: Optional[float] = None) -> List[int]:
@@ -161,6 +171,9 @@ class Supervisor:
     def next_world(self) -> int:
         if self.elastic == "shrink" and self.dead:
             return max(self.MIN_WORLD, self.world - len(self.dead))
+        # "fixed" and "rejoin" keep the world size: fixed relaunches
+        # everyone at it, rejoin keeps the survivors running and refills
+        # the dead slots in place
         return self.world
 
     def plan_relaunch(self) -> int:
@@ -169,3 +182,20 @@ class Supervisor:
         self.dead.clear()
         self.exit_codes.clear()
         return self.world
+
+    # -- live rejoin (elastic="rejoin") -------------------------------
+
+    def rejoinable(self, rank: int) -> bool:
+        """Should the launcher respawn just ``rank`` instead of folding
+        its death into a whole-world relaunch?"""
+        return self.elastic == "rejoin" and rank in self.dead
+
+    def note_rejoined(self, rank: int) -> int:
+        """A respawned rank completed its handshake (or at least came
+        back up): drop it from the dead set so heartbeat scans age its
+        FRESH records instead of instantly re-declaring it, and bump
+        the membership epoch. Returns the new epoch."""
+        self.dead.discard(rank)
+        self.exit_codes.pop(rank, None)
+        self.epoch += 1
+        return self.epoch

@@ -1261,6 +1261,11 @@ class AsyncSGD:
                             args={"tau": tau}):
             self.store.ps_push(res["grad"], tau=float(tau))
         m = np.asarray(res["metrics"], np.float64)
+        if "vv" in res:
+            # live-rejoin bookkeeping: the one-hot rows sum to the full
+            # per-rank window-counter vector (ft/rejoin.VersionVector);
+            # merge is max so replay/stale rows never regress
+            self._rejoin_vv.merge_row(res["vv"])
         if m[1] > 0:
             local.objv += float(m[0])
             local.num_ex += int(m[1])
@@ -1289,6 +1294,13 @@ class AsyncSGD:
 
         it = batches()
         window = max(1, cfg.ps_window_steps)
+        # version-vector piggyback, only when a replay log is live: the
+        # wire payload stays byte-identical with rejoin off (tau=0
+        # parity with the BSP oracle is pinned by test_ps_engine.py)
+        vv_on = engine.replay is not None
+        if vv_on and not hasattr(self, "_rejoin_vv"):
+            from wormhole_tpu.ft.rejoin import VersionVector
+            self._rejoin_vv = VersionVector(self.rt.world)
         stop = False
         while not stop:
             if ft_supervisor.drain_requested():
@@ -1328,6 +1340,11 @@ class AsyncSGD:
                 "metrics": mets.astype(np.float32),
                 "have": np.int64(have_local),
             }
+            if vv_on:
+                # own window count in own slot; the delta sum-allreduce
+                # reconstructs the full vector at zero extra collectives
+                self._rejoin_vv.bump(self.rt.rank)
+                payload["vv"] = self._rejoin_vv.one_hot(self.rt.rank)
             engine.submit(
                 # ps-engine: the closure executes on the drain thread
                 lambda p=payload: allreduce_tree(

@@ -113,6 +113,11 @@ SPAN_TABLE: Dict[str, str] = {
     "ps:exchange": "collective_wait",
     "ps:gate": "collective_wait",
     "ps:apply": "device_compute",
+    # live rank rejoin (ft/rejoin.py): the handshake is membership
+    # bookkeeping off the step loop; the replay applies reduced deltas
+    # to the restored store (device pushes)
+    "rejoin:handshake": "other",
+    "rejoin:replay": "device_compute",
 }
 
 # DeviceFeed stage -> bucket, for dynamic ``<feed>:<stage>`` span names

@@ -115,11 +115,26 @@ class Checkpointer:
     # --- helpers ---
 
     def latest_version(self) -> int:
+        """Newest committed version, tolerating a torn directory read.
+
+        A rejoining rank scans while a concurrent ``save`` may be
+        mid-``os.replace``; on some filesystems that can surface a
+        transient OSError from ``listdir``. One retry (mirroring
+        ``_commit_bytes``) turns the race into the benign outcome of
+        seeing either the old or the new version."""
         if not self.dir or not os.path.isdir(self.dir):
             return 0
-        vers = [int(m.group(1)) for n in os.listdir(self.dir)
-                if (m := _FNAME.match(n))]
-        return max(vers, default=0)
+        for attempt in (0, 1):
+            try:
+                _chaos.rejoin_ckpt_fault(self.dir)
+                vers = [int(m.group(1)) for n in os.listdir(self.dir)
+                        if (m := _FNAME.match(n))]
+                return max(vers, default=0)
+            except OSError as e:
+                if attempt:
+                    raise
+                log.warning("torn version scan of %s (%s); retrying "
+                            "once", self.dir, e)
 
     def _path(self, version: int) -> str:
         return os.path.join(self.dir, f"ckpt_v{version}.msgpack")
@@ -182,12 +197,20 @@ class ShardCheckpointer:
     shared across hosts (each rank sees only its own writes; the caller
     allreduce-mins the per-rank versions to agree on the resume point)."""
 
-    def __init__(self, directory: str, keep: int = 2) -> None:
-        import jax
+    def __init__(self, directory: str, keep: int = 2,
+                 rank: Optional[int] = None,
+                 world: Optional[int] = None) -> None:
         self.dir = directory
         self.keep = keep
-        self.rank = jax.process_index()
-        self.world = jax.process_count()
+        # rank/world default to the jax process topology; explicit
+        # overrides serve callers outside it — the live-rejoin drill's
+        # simulated ranks, or a rejoiner restoring ANOTHER rank's shard
+        if rank is None or world is None:
+            import jax
+            rank = jax.process_index() if rank is None else rank
+            world = jax.process_count() if world is None else world
+        self.rank = int(rank)
+        self.world = int(world)
         if self.dir:
             os.makedirs(os.path.join(self.dir, f"rank{self.rank}"),
                         exist_ok=True)
@@ -334,11 +357,22 @@ class ShardCheckpointer:
         if not d or not os.path.isdir(d):
             return 0
         ok = re.compile(r"^ckpt_v(\d+)\.ok$")
-        vers = [int(m.group(1)) for n in os.listdir(d)
-                if (m := ok.match(n))
-                and os.path.exists(self._rank_path(int(m.group(1)),
-                                                   self.rank))]
-        return max(vers, default=0)
+        # one retry on a torn read: the rejoin load path scans while
+        # survivors may be committing (same rationale and pattern as
+        # Checkpointer.latest_version / _commit_bytes)
+        for attempt in (0, 1):
+            try:
+                _chaos.rejoin_ckpt_fault(d)
+                vers = [int(m.group(1)) for n in os.listdir(d)
+                        if (m := ok.match(n))
+                        and os.path.exists(self._rank_path(int(m.group(1)),
+                                                           self.rank))]
+                return max(vers, default=0)
+            except OSError as e:
+                if attempt:
+                    raise
+                log.warning("torn version scan of %s (%s); retrying "
+                            "once", d, e)
 
     def _gc(self, newest: int) -> None:
         # each rank cleans its own dir (other ranks' dirs may not even be

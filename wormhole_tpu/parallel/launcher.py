@@ -99,7 +99,8 @@ def _attempt_dir(directory: str, attempt: int) -> str:
 def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
               straggler_factor: float = 3.0, trace_dir: str = "",
               attempt: int = 0, supervisor=None,
-              comm_timeout_s: float = 0.0, drain: bool = False) -> int:
+              comm_timeout_s: float = 0.0, drain: bool = False,
+              rejoin_budget: int = 0) -> int:
     import threading
     port = _free_port()
     procs = []
@@ -126,7 +127,7 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
         monitor = HeartbeatMonitor(heartbeat_dir,
                                    factor=straggler_factor,
                                    sink=_warn).start()
-    for i in range(n):
+    def _spawn(i: int, attempt_idx: int, rejoin: bool = False):
         env = _base_env()
         env["JAX_PLATFORMS"] = "cpu"
         # children write a pipe (block-buffered by default): unbuffer so
@@ -137,8 +138,15 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
         env["NUM_PROCESSES"] = str(n)
         env["PROCESS_ID"] = str(i)
         # relaunch attempt index: chaos injection (ft/chaos.py) fires
-        # only on attempt 0, so a supervised retry comes up clean
-        env["WORMHOLE_ATTEMPT"] = str(attempt)
+        # only on attempt 0, so a supervised retry — and a rejoined
+        # rank, which gets attempt+1 while survivors keep their original
+        # index — comes up clean
+        env["WORMHOLE_ATTEMPT"] = str(attempt_idx)
+        if rejoin:
+            # respawned into a live world: the learner takes the
+            # checkpoint-restore + handshake + replay path
+            # (ft/supervisor.REJOIN_ENV)
+            env["WORMHOLE_REJOIN_RANK"] = str(i)
         if comm_timeout_s > 0:
             env["WORMHOLE_COMM_TIMEOUT_S"] = str(comm_timeout_s)
         if drain:
@@ -162,8 +170,19 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
                                  daemon=True)
             t.start()
             pumps.append(t)
+        return p
+
+    for i in range(n):
+        _spawn(i, attempt)
     import time as _time
     rc = 0
+    # live rejoin (supervisor.elastic == "rejoin"): a dead rank is
+    # respawned into the still-running world instead of tearing the
+    # whole job down for a relaunch
+    rejoin_left = int(rejoin_budget) if (
+        supervisor is not None
+        and getattr(supervisor, "elastic", "") == "rejoin") else 0
+    respawned: set = set()
     try:
         # poll ALL ranks: as soon as any child dies nonzero, the rest are
         # wedged on collectives waiting for it — terminate them NOW so the
@@ -180,12 +199,45 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
                 del live[r]
                 if supervisor is not None:
                     supervisor.record_exit(r, code)
+                if code != 0 and rejoin_left > 0 \
+                        and supervisor is not None \
+                        and supervisor.rejoinable(r):
+                    # survivors keep running: respawn ONLY the dead rank
+                    # (attempt+1 so chaos doesn't re-fire) and let it
+                    # catch up via checkpoint + delta replay
+                    rejoin_left -= 1
+                    with out_lock:
+                        sys.stderr.write(
+                            f"[launcher] rank {r} lost (rc={code}); "
+                            f"live rejoin — survivors keep running "
+                            f"({rejoin_left} rejoin(s) left)\n")
+                        sys.stderr.flush()
+                    live[r] = _spawn(r, attempt + 1, rejoin=True)
+                    respawned.add(r)
+                    continue
                 rc = rc or code   # first failure wins (terminated
                                   # bystanders exit -15 and must not
                                   # mask the originating code)
                 if code != 0:
                     for q in live.values():
                         q.terminate()
+            if respawned and supervisor is not None:
+                # a respawned rank stays in the supervisor's dead set
+                # (so the heartbeat scan doesn't SIGKILL it off its
+                # STALE pre-death record) until fresh heartbeats show
+                # up — or immediately when heartbeats aren't wired
+                stale = set(supervisor.detector.check(heartbeat_dir)) \
+                    if heartbeat_dir else set()
+                for r in sorted(respawned):
+                    if r in live and r not in stale:
+                        supervisor.note_rejoined(r)
+                        respawned.discard(r)
+                        with out_lock:
+                            sys.stderr.write(
+                                f"[launcher] rank {r} rejoined "
+                                f"(membership epoch "
+                                f"{supervisor.epoch})\n")
+                            sys.stderr.flush()
             now = _time.monotonic()
             if supervisor is not None and heartbeat_dir \
                     and now - last_scan >= 1.0:
@@ -268,6 +320,16 @@ def launch_mp_supervised(n: int, cmd: List[str], restarts: int = 0,
     version. See docs/fault_tolerance.md for the state machine."""
     from wormhole_tpu.ft.supervisor import Supervisor
     sup = Supervisor(n, elastic=elastic, dead_after_s=dead_after_s)
+    if elastic == "rejoin":
+        # no stop-the-world: one launch, with the restarts budget spent
+        # on per-rank respawns into the live world. A failure that
+        # exhausts the budget (or isn't rejoinable) fails the job — the
+        # caller opted out of whole-world relaunches.
+        return launch_mp(sup.world, cmd, heartbeat_dir=heartbeat_dir,
+                         straggler_factor=straggler_factor,
+                         trace_dir=trace_dir, attempt=0,
+                         supervisor=sup, comm_timeout_s=comm_timeout_s,
+                         drain=True, rejoin_budget=restarts)
     attempt = 0
     while True:
         rc = launch_mp(sup.world, cmd, heartbeat_dir=heartbeat_dir,
@@ -320,10 +382,14 @@ def main(argv: List[str] = None) -> int:
                          "silence, SIGTERM-drain the survivors and "
                          "relaunch (uses the --restarts budget). 0 = "
                          "unsupervised (plain whole-job restarts)")
-    ap.add_argument("--ft-elastic", choices=("fixed", "shrink"),
+    ap.add_argument("--ft-elastic", choices=("fixed", "shrink", "rejoin"),
                     default="fixed",
                     help="supervised relaunch geometry: same world size "
-                         "(fixed) or shrink to the survivors")
+                         "(fixed), shrink to the survivors, or rejoin — "
+                         "survivors keep running and only the dead rank "
+                         "is respawned into the live world (checkpoint "
+                         "restore + delta replay; uses the --restarts "
+                         "budget for per-rank respawns)")
     ap.add_argument("--comm-timeout", type=float, default=0.0,
                     help="mp only: exported collective watchdog timeout "
                          "(WORMHOLE_COMM_TIMEOUT_S) — a worker blocked "
@@ -337,7 +403,8 @@ def main(argv: List[str] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("no command given (append: -- python app.py ...)")
-    if args.cluster == "mp" and args.ft_dead_after > 0:
+    if args.cluster == "mp" and (args.ft_dead_after > 0
+                                 or args.ft_elastic == "rejoin"):
         return launch_mp_supervised(
             args.num_devices, cmd, restarts=args.restarts,
             heartbeat_dir=args.heartbeat_dir,

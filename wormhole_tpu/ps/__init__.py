@@ -7,11 +7,13 @@ thread drains delta-window exchanges while the training loop runs up to
 the determinism invariants, and the knobs.
 """
 
-from wormhole_tpu.ps.config import build_engine
+from wormhole_tpu.ps.config import build_engine, replay_depth
 from wormhole_tpu.ps.delay import DelayTracker
 from wormhole_tpu.ps.engine import ExchangeEngine, Ticket
 from wormhole_tpu.ps.queue import QueueClosed, WindowQueue
-from wormhole_tpu.ps.telemetry import PsMetrics, ps_metrics
+from wormhole_tpu.ps.telemetry import (PsMetrics, RejoinMetrics,
+                                       ps_metrics, rejoin_metrics)
 
-__all__ = ["build_engine", "DelayTracker", "ExchangeEngine", "Ticket",
-           "QueueClosed", "WindowQueue", "PsMetrics", "ps_metrics"]
+__all__ = ["build_engine", "replay_depth", "DelayTracker",
+           "ExchangeEngine", "Ticket", "QueueClosed", "WindowQueue",
+           "PsMetrics", "ps_metrics", "RejoinMetrics", "rejoin_metrics"]

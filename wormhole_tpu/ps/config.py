@@ -7,7 +7,17 @@ from typing import Optional
 from wormhole_tpu.ps.engine import ExchangeEngine
 from wormhole_tpu.ps.telemetry import ps_metrics
 
-__all__ = ["build_engine"]
+__all__ = ["build_engine", "replay_depth"]
+
+
+def replay_depth(cfg) -> int:
+    """Replay-log depth for live rejoin, 0 = no log. The tau term covers
+    windows in flight when a checkpoint was cut; the knob covers
+    detection + relaunch latency (docs/fault_tolerance.md)."""
+    windows = int(getattr(cfg, "rejoin_replay_windows", 0))
+    if windows <= 0:
+        return 0
+    return max(int(cfg.staleness_tau), 0) + windows
 
 
 def build_engine(cfg, registry=None) -> Optional[ExchangeEngine]:
@@ -20,6 +30,11 @@ def build_engine(cfg, registry=None) -> Optional[ExchangeEngine]:
             f"ps_window_steps={cfg.ps_window_steps}: need >= 1 device "
             "steps per exchanged delta window")
     metrics = ps_metrics(registry) if registry is not None else None
+    depth = replay_depth(cfg)
+    replay = None
+    if depth > 0:
+        from wormhole_tpu.ft.rejoin import ReplayLog
+        replay = ReplayLog(depth)
     return ExchangeEngine(cfg.staleness_tau,
                           queue_depth=cfg.ps_queue_depth,
-                          metrics=metrics)
+                          metrics=metrics, replay=replay)

@@ -89,7 +89,7 @@ class ExchangeEngine:
     """
 
     def __init__(self, staleness_tau: int, queue_depth: int = 0,
-                 metrics=None) -> None:
+                 metrics=None, replay=None) -> None:
         if staleness_tau < 0:
             raise ValueError(f"staleness_tau={staleness_tau} < 0: "
                              "negative tau means 'engine off'; build "
@@ -100,6 +100,10 @@ class ExchangeEngine:
         self._q = WindowQueue(bound + 1)
         self._pending: deque = deque()  # delta tickets, submission order
         self._metrics = metrics
+        # live-rejoin replay log (ft/rejoin.ReplayLog or None): every
+        # successfully reduced delta window is recorded from the drain
+        # thread so a rejoining rank can fetch what it missed
+        self.replay = replay
         self.delays = DelayTracker()
         self._n_delta = 0
         self._n_control = 0
@@ -125,6 +129,8 @@ class ExchangeEngine:
             dt = time.monotonic() - start
             if t.kind == "delta":
                 self.delays.on_exchange(dt)
+                if t.error is None and self.replay is not None:
+                    self.replay.record(t.index, t.result)
                 if self._metrics is not None:
                     self._metrics.windows.inc()
                     self._metrics.exchange_s.inc(dt)

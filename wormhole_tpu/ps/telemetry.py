@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-__all__ = ["PsMetrics", "ps_metrics"]
+__all__ = ["PsMetrics", "ps_metrics", "RejoinMetrics", "rejoin_metrics"]
 
 
 class PsMetrics(NamedTuple):
@@ -36,3 +36,31 @@ def ps_metrics(reg) -> PsMetrics:
         reg.gauge("ps/overlap_frac",
                   help="fraction of exchange time hidden behind local "
                        "compute (1 - blocked_s/exchange_s)"))
+
+
+class RejoinMetrics(NamedTuple):
+    epoch: object            # gauge: membership epoch after the rejoin
+    replayed: object         # counter: reduced windows replayed
+    replay_evicted: object   # counter: replay-log entries evicted
+    recovery_debt_s: object  # gauge: detection -> admission seconds
+
+
+def rejoin_metrics(reg) -> RejoinMetrics:
+    """Live-rejoin observability (ft/rejoin.py); single declaration
+    site, same contract as :func:`ps_metrics`."""
+    return RejoinMetrics(
+        reg.gauge("ft/rejoin_epoch",
+                  help="membership epoch after the most recent "
+                       "death/rejoin (0 = membership never changed)",
+                  agg="max"),
+        reg.counter("ft/rejoin_replayed",
+                    help="reduced delta windows replayed into rejoining "
+                         "ranks from survivors' replay logs"),
+        reg.counter("ft/rejoin_replay_evicted",
+                    help="replay-log entries evicted past the bounded "
+                         "depth (a rejoiner needing one of these must "
+                         "take the stop-the-world path)"),
+        reg.gauge("ft/rejoin_recovery_debt_s",
+                  help="seconds from dead-rank detection to the "
+                       "rejoiner's admission at a window boundary",
+                  agg="max"))

@@ -17,6 +17,7 @@ process), so "worker id" is any hashable caller identity.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -53,6 +54,13 @@ class WorkloadPool:
                  time_fn=time.monotonic) -> None:
         self.straggler_factor = straggler_factor
         self._time = time_fn
+        # one re-entrant lock over every public method: the live-rejoin
+        # supervisor calls reset(dead_rank) from its own thread while
+        # survivors claim/finish parts, and an unguarded interleaving
+        # (reset pops an _Assigned while get() is mutating its workers
+        # set, or between finish()'s pop and _done_ids.add) can
+        # double-assign a part or drop it entirely
+        self._lock = threading.RLock()
         self._queue: List[Workload] = []
         self._assigned: Dict[int, _Assigned] = {}
         self._done_ids: set = set()
@@ -68,19 +76,35 @@ class WorkloadPool:
         if not files:
             raise FileNotFoundError(f"no files match {pattern!r}")
         n = 0
-        for fi in files:
-            for p in range(npart):
-                self._queue.append(Workload(fi.path, p, npart, kind,
-                                            self._next_id))
-                self._next_id += 1
-                n += 1
+        with self._lock:
+            for fi in files:
+                for p in range(npart):
+                    self._queue.append(Workload(fi.path, p, npart, kind,
+                                                self._next_id))
+                    self._next_id += 1
+                    n += 1
         log.info("added %d parts from %d files (%s)", n, len(files), pattern)
         return n
 
+    def add_parts(self, parts: List[Workload]) -> int:
+        """Enqueue pre-built workloads (the in-process rejoin drill's
+        synthetic parts; ``add`` stays the file-pattern surface).
+        Assigns fresh ids to parts carrying the default ``-1``."""
+        with self._lock:
+            for wl in parts:
+                if wl.id < 0:
+                    wl.id = self._next_id
+                    self._next_id += 1
+                else:
+                    self._next_id = max(self._next_id, wl.id + 1)
+                self._queue.append(wl)
+            return len(parts)
+
     def clear(self) -> None:
-        self._queue.clear()
-        self._assigned.clear()
-        self._done_ids.clear()
+        with self._lock:
+            self._queue.clear()
+            self._assigned.clear()
+            self._done_ids.clear()
 
     def take_static(self, world: int, rank: int) -> List[Workload]:
         """Deterministic round-robin split of the (replicated) queue:
@@ -88,73 +112,90 @@ class WorkloadPool:
         engine pass uses this instead of the dynamic claim protocol —
         the per-round claim collective exists to absorb stragglers, and
         bounded staleness already does that (a slow rank delays only
-        the windows it contributes to, not a lockstep round)."""
-        mine = [wl for i, wl in enumerate(self._queue)
-                if i % world == rank]
-        self._queue.clear()
-        return mine
+        the windows it contributes to, not a lockstep round).
+
+        Every part is registered as assigned to its owning rank, so a
+        later ``reset(dead_rank)`` re-queues exactly the dead rank's
+        split for survivors to ``get`` — before this, reset after a
+        static split was silently a no-op and a dead rank's shards were
+        simply lost."""
+        with self._lock:
+            mine: List[Workload] = []
+            now = self._time()
+            for i, wl in enumerate(self._queue):
+                owner = i % world
+                self._assigned[wl.id] = _Assigned(wl, {owner}, now, now)
+                if owner == rank:
+                    mine.append(wl)
+            self._queue.clear()
+            return mine
 
     def get(self, worker: object) -> Optional[Workload]:
         """Assign the next part to ``worker``; when the queue is empty,
         consider re-issuing a straggler (workload_pool.h:98-167,169-190)."""
-        if not self._queue:
-            self._requeue_stragglers()
-        while self._queue:
-            wl = self._queue.pop(0)
-            if wl.id in self._done_ids:
-                continue  # completed by another copy while re-queued
-            existing = self._assigned.get(wl.id)
-            now = self._time()
-            if existing is not None:
-                # a straggler copy: the is_rerun guard stays set (never a
-                # 3rd unprompted copy), but the new worker is tracked so
-                # its death re-queues the part, and duration stats use the
-                # fresh start
-                existing.is_rerun = True
-                existing.workers.add(worker)
-                existing.last_start = now
-            else:
-                self._assigned[wl.id] = _Assigned(wl, {worker}, now, now)
-            return wl
-        return None
+        with self._lock:
+            if not self._queue:
+                self._requeue_stragglers()
+            while self._queue:
+                wl = self._queue.pop(0)
+                if wl.id in self._done_ids:
+                    continue  # completed by another copy while re-queued
+                existing = self._assigned.get(wl.id)
+                now = self._time()
+                if existing is not None:
+                    # a straggler copy: the is_rerun guard stays set (never a
+                    # 3rd unprompted copy), but the new worker is tracked so
+                    # its death re-queues the part, and duration stats use the
+                    # fresh start
+                    existing.is_rerun = True
+                    existing.workers.add(worker)
+                    existing.last_start = now
+                else:
+                    self._assigned[wl.id] = _Assigned(wl, {worker}, now, now)
+                return wl
+            return None
 
     def finish(self, workload_id: int) -> None:
         """Mark a part done (either copy); record duration for the
         straggler threshold (workload_pool.h:131-148)."""
-        a = self._assigned.pop(workload_id, None)
-        if a is not None:
-            dur = self._time() - a.last_start
-            if not a.is_rerun:
-                # duplicated parts are excluded from the duration stats:
-                # finish() can't tell which copy completed, and either
-                # choice (inflated straggler time or near-zero original-
-                # completes-after-rerun time) would skew the 3x threshold
-                self._durations.append(dur)
-            log.info("finished part %d of %s in %.2fs", a.wl.part,
-                     a.wl.file, dur)
-        self._done_ids.add(workload_id)
-        self._queue = [w for w in self._queue if w.id != workload_id]
+        with self._lock:
+            a = self._assigned.pop(workload_id, None)
+            if a is not None:
+                dur = self._time() - a.last_start
+                if not a.is_rerun:
+                    # duplicated parts are excluded from the duration stats:
+                    # finish() can't tell which copy completed, and either
+                    # choice (inflated straggler time or near-zero original-
+                    # completes-after-rerun time) would skew the 3x threshold
+                    self._durations.append(dur)
+                log.info("finished part %d of %s in %.2fs", a.wl.part,
+                         a.wl.file, dur)
+            self._done_ids.add(workload_id)
+            self._queue = [w for w in self._queue if w.id != workload_id]
 
     def reset(self, worker: object) -> None:
         """Node-failure handler: re-queue everything assigned to ``worker``
         (AddNodeFailureHandler → pool_.Reset, async_sgd.h:248-250)."""
-        dead = [wid for wid, a in self._assigned.items()
-                if worker in a.workers]
-        for wid in dead:
-            a = self._assigned[wid]
-            a.workers.discard(worker)
-            if a.workers:
-                continue  # another copy is still running this part
-            self._assigned.pop(wid)
-            log.info("re-queue part %d of %s from failed worker %r",
-                     a.wl.part, a.wl.file, worker)
-            self._queue.insert(0, a.wl)
+        with self._lock:
+            dead = [wid for wid, a in self._assigned.items()
+                    if worker in a.workers]
+            for wid in dead:
+                a = self._assigned[wid]
+                a.workers.discard(worker)
+                if a.workers:
+                    continue  # another copy is still running this part
+                self._assigned.pop(wid)
+                log.info("re-queue part %d of %s from failed worker %r",
+                         a.wl.part, a.wl.file, worker)
+                self._queue.insert(0, a.wl)
 
     def is_finished(self) -> bool:
-        return not self._queue and not self._assigned
+        with self._lock:
+            return not self._queue and not self._assigned
 
     def pending(self) -> int:
-        return len(self._queue) + len(self._assigned)
+        with self._lock:
+            return len(self._queue) + len(self._assigned)
 
     # -- straggler re-execution ---------------------------------------------
     #

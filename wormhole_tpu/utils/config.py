@@ -145,6 +145,13 @@ class Config:
     ps_window_steps: int = 1
     # engine queue bound; 0 = derive from staleness_tau (tau + 1)
     ps_queue_depth: int = 0
+    # live-rejoin delta replay (ft/rejoin.py): each engine keeps the
+    # last max(staleness_tau, 0) + rejoin_replay_windows reduced delta
+    # windows so a relaunched rank can catch up from checkpoint +
+    # replay instead of a stop-the-world relaunch. 0 = no replay log
+    # (rejoin machinery fully off; wire bytes and tau=0 parity are
+    # untouched).
+    rejoin_replay_windows: int = 0
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100
@@ -226,7 +233,9 @@ class Config:
     # drain + relaunch cycle. 0 = unsupervised.
     ft_dead_after_s: float = 0.0
     # relaunch geometry after a dead rank: "fixed" re-runs at the same
-    # world size, "shrink" drops to the survivors (floor 2)
+    # world size, "shrink" drops to the survivors (floor 2), "rejoin"
+    # keeps survivors running and respawns only the dead rank, which
+    # catches up via checkpoint + delta replay (ft/rejoin.py)
     ft_elastic: str = "fixed"
     # --- chaos fault injection (ft/chaos.py; inert unless set, and only
     # ever fires on attempt 0 of a supervised run) ---
@@ -236,6 +245,12 @@ class Config:
     chaos_collective_delay_s: float = 0.0  # sleep before each host collective
     chaos_heartbeat_delay_s: float = 0.0   # sleep inside each heartbeat write
     chaos_ckpt_errors: int = 0    # transient checkpoint-IO errors to inject
+    # sleep inside the live-rejoin handshake before the rejoiner attaches
+    # (stretches the replay gap the bounded log must absorb)
+    chaos_rejoin_handshake_delay_s: float = 0.0
+    # transient OSErrors injected into the rejoin-path latest_version
+    # directory scans (torn read racing a concurrent save; retried once)
+    chaos_rejoin_ckpt_transient: int = 0
 
     def merged(self, kvs: Sequence[str]) -> "Config":
         """Return a copy with ``key=value`` tokens merged over this config."""

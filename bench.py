@@ -1852,6 +1852,10 @@ def main(argv=None) -> None:
     ap.add_argument("--trace-path", default="",
                     help="also write the accumulated spans as Chrome "
                          "trace-event JSON (view at ui.perfetto.dev)")
+    ap.add_argument("--sample-itv", type=float, default=0.5,
+                    help="timeline sampler interval in seconds for the "
+                         "per-phase timeline block (obs/timeline.py); "
+                         "0 disables the sampler")
     args = ap.parse_args(argv)
     if args.budget > 0:
         # in-phase truncation (between rounds/stages) shares the same
@@ -1919,12 +1923,21 @@ def main(argv=None) -> None:
     failed: dict = {}
     telemetry: dict = {}
     trace_events: list = []
+    sampler = None
     if args.telemetry:
         # ring-only span recording (no files unless --trace-path); the
         # per-phase summaries land in the --out JSON, which records
         # where the time went, not just how much
         from wormhole_tpu.obs import trace
         trace.enable(args.trace_path, ring=1 << 18)
+        if args.sample_itv > 0:
+            # rolling-window sampler over the default registry: each
+            # phase's samples become a `timeline` block in the summary,
+            # with the sampler's own measured cost alongside so the
+            # overhead claim is a number, not an assertion
+            from wormhole_tpu.obs import TimelineSampler
+            sampler = TimelineSampler(interval_s=args.sample_itv,
+                                      ring=4096).start()
     bench_t0 = time.perf_counter()
     todo = [p for p in PHASES if p in sel]
 
@@ -1950,6 +1963,9 @@ def main(argv=None) -> None:
                   file=sys.stderr, flush=True)
             break
         print(f"[bench] {name}...", file=sys.stderr, flush=True)
+        if sampler is not None:
+            sampler.set_phase(name)
+            tick_s0 = sampler.tick_s
         t0 = time.perf_counter()
         try:
             results[name] = runners[name]()
@@ -1966,6 +1982,18 @@ def main(argv=None) -> None:
             phase_sec = time.perf_counter() - t0
             telemetry[name] = _phase_telemetry(wall_s=phase_sec)
             telemetry[name]["phase_sec"] = round(phase_sec, 3)
+            if sampler is not None:
+                from wormhole_tpu.obs import timeline as _timeline
+                tl = _timeline.summarize(
+                    [s for s in sampler.samples()
+                     if s.get("phase") == name])
+                tl["sampler"] = {
+                    "interval_s": args.sample_itv,
+                    # measured sampler cost as a fraction of phase wall
+                    "overhead_frac": round(
+                        (sampler.tick_s - tick_s0)
+                        / max(phase_sec, 1e-9), 6)}
+                telemetry[name]["timeline"] = tl
             if args.trace_path:
                 trace_events.extend(trace.events())
             trace.reset()        # each phase gets the whole ring
@@ -1974,6 +2002,8 @@ def main(argv=None) -> None:
                                   for p in todo[i + 1:]):
             stores_box.clear()   # free the HBM tables for later phases
 
+    if sampler is not None:
+        sampler.stop()
     if args.telemetry and args.trace_path:
         from wormhole_tpu.obs import trace
         trace_events.extend(trace.events())

@@ -25,6 +25,13 @@ regresses against its predecessor:
   values (rejoin phase: detection → rejoiner admitted) must stay under
   ``--max-recovery-debt`` — a ceiling, not a trend, because past the
   drill's group timeout the handshake is dead by definition.
+- **SLO timeline** (``--slo``, absolute): the NEWEST run's per-phase
+  ``timeline`` blocks (bench.py ``--sample-itv`` sampler;
+  ``obs/timeline.summarize``) must keep their first-vs-last-quartile
+  ex/s drift under ``--max-drift`` and every declared SLO objective's
+  burn rate under ``--max-burn``. A run with no timeline blocks is
+  skipped with a note — absent telemetry is a tooling gap, not a
+  violation.
 - **Ledger fractions**: when both runs carry a ledger block (bench.py
   ``--out`` telemetry, ``{"ledger": {"frac": {...}}}`` anywhere under
   ``parsed``), the ``unattributed`` and ``residual_stall`` fractions may
@@ -96,6 +103,14 @@ _MIN_FUSED_RATIO = 1.0
 # a replay path that wedges into its GroupTimeout (the drill's
 # survivors wait 60s before declaring the handshake dead)
 _MAX_RECOVERY_DEBT = 60.0
+# --slo defaults: absolute gates over the newest run's per-phase
+# `timeline` blocks (bench.py --sample-itv; obs/timeline.summarize).
+# Drift is the first-vs-last-quartile ex/s decay WITHIN a phase — a
+# 6-second CPU phase jitters hard, so 0.5 catches a halving without
+# flagging warm-up noise; burn > 1.0 means an SLO error budget spends
+# faster than its window by definition (obs/slo.py).
+_MAX_DRIFT = 0.5
+_MAX_BURN = 1.0
 
 
 def load_runs(bench_dir: str,
@@ -288,11 +303,63 @@ def debt_ceiling(name: str, parsed: dict, max_debt: float) -> List[str]:
         if v > max_debt]
 
 
+def timeline_blocks(parsed: dict) -> Dict[str, dict]:
+    """Dotted path -> per-phase ``timeline`` block (bench.py --out
+    telemetry, ``{"timeline": {...}}`` anywhere under ``parsed``)."""
+    out: Dict[str, dict] = {}
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else str(k)
+            if k == "timeline" and isinstance(v, dict):
+                out[p] = v
+            elif isinstance(v, dict):
+                walk(v, p)
+
+    walk(parsed, "")
+    return out
+
+
+def slo_gate(name: str, parsed: dict, max_drift: float = _MAX_DRIFT,
+             max_burn: float = _MAX_BURN) -> List[str]:
+    """Absolute SLO gate on the newest run's timeline blocks: in-phase
+    ex/s quartile drift and per-objective burn rates (obs/slo.py). A
+    run with no timeline blocks (sampler off, or a pre-timeline
+    snapshot) is skipped with a note — absent telemetry is a tooling
+    gap, not an SLO violation."""
+    blocks = timeline_blocks(parsed)
+    if not blocks:
+        print(f"bench_check: {name}: no timeline blocks; "
+              "--slo gate skipped")
+        return []
+    bad: List[str] = []
+    for path, tl in sorted(blocks.items()):
+        exs = tl.get("ex_per_sec")
+        drift = exs.get("drift_frac") if isinstance(exs, dict) else None
+        if isinstance(drift, (int, float)) and drift > max_drift:
+            bad.append(
+                f"{path}.ex_per_sec.drift_frac: {drift:.3f} > "
+                f"--max-drift {max_drift:.3f} ({name}) — throughput "
+                "decaying within the phase")
+        for obj, row in sorted((tl.get("slo") or {}).items()):
+            burn = row.get("burn") if isinstance(row, dict) else None
+            if isinstance(burn, (int, float)) and burn > max_burn:
+                bad.append(
+                    f"{path}.slo.{obj}.burn: {burn:.2f} > --max-burn "
+                    f"{max_burn:.2f} ({name}) — SLO error budget "
+                    "spending faster than its window")
+    return bad
+
+
 def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      tol_frac: float, all_pairs: bool,
                      min_scaling: float, min_fused_ratio: float,
-                     max_recovery_debt: float) -> Tuple[List[str], int,
-                                                        int]:
+                     max_recovery_debt: float, slo: bool = False,
+                     max_drift: float = _MAX_DRIFT,
+                     max_burn: float = _MAX_BURN
+                     ) -> Tuple[List[str], int, int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
             if p is not None]
@@ -302,6 +369,9 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
     if prefix == "BENCH" and runs:
         failures.extend(fused_floor(*runs[-1], min_fused_ratio))
         failures.extend(debt_ceiling(*runs[-1], max_recovery_debt))
+    if slo and runs:
+        failures.extend(slo_gate(*runs[-1], max_drift=max_drift,
+                                 max_burn=max_burn))
     if len(runs) < 2:
         print(f"bench_check: {len(runs)} usable {prefix} run(s) under "
               f"{bench_dir!r}; nothing to gate pairwise")
@@ -319,13 +389,17 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
 def run(bench_dir: str, tol: float, tol_frac: float,
         all_pairs: bool = False, min_scaling: float = _MIN_SCALING,
         min_fused_ratio: float = _MIN_FUSED_RATIO,
-        max_recovery_debt: float = _MAX_RECOVERY_DEBT) -> int:
+        max_recovery_debt: float = _MAX_RECOVERY_DEBT,
+        slo: bool = False, max_drift: float = _MAX_DRIFT,
+        max_burn: float = _MAX_BURN) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
         f, p, c = _gate_trajectory(prefix, bench_dir, tol, tol_frac,
                                    all_pairs, min_scaling,
-                                   min_fused_ratio, max_recovery_debt)
+                                   min_fused_ratio, max_recovery_debt,
+                                   slo=slo, max_drift=max_drift,
+                                   max_burn=max_burn)
         failures.extend(f)
         pairs += p
         compared += c
@@ -373,11 +447,26 @@ def main(argv=None) -> int:
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
+    ap.add_argument("--slo", action="store_true",
+                    help="also gate the newest run's per-phase "
+                         "`timeline` blocks: ex/s drift and SLO burn "
+                         "rates (skipped with a note when the run "
+                         "carries no timeline)")
+    ap.add_argument("--max-drift", type=float, default=_MAX_DRIFT,
+                    help="(--slo) ceiling on a phase's first-vs-last-"
+                         "quartile ex/s decay fraction (default "
+                         f"{_MAX_DRIFT})")
+    ap.add_argument("--max-burn", type=float, default=_MAX_BURN,
+                    help="(--slo) ceiling on any SLO objective's burn "
+                         f"rate (default {_MAX_BURN}; > 1.0 spends the "
+                         "error budget faster than its window)")
     args = ap.parse_args(argv)
     return run(args.dir, args.tol, args.tol_frac,
                all_pairs=args.all_pairs, min_scaling=args.min_scaling,
                min_fused_ratio=args.min_fused_ratio,
-               max_recovery_debt=args.max_recovery_debt)
+               max_recovery_debt=args.max_recovery_debt,
+               slo=args.slo, max_drift=args.max_drift,
+               max_burn=args.max_burn)
 
 
 if __name__ == "__main__":

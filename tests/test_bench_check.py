@@ -321,3 +321,62 @@ def test_rejoin_p99_trend_gated_like_serve_latency(tmp_path):
     r = _run("--dir", d)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "rejoin.rejoin_p99_ms" in r.stderr
+
+
+# -- --slo: absolute timeline gate on the newest run -------------------------
+
+def _timeline(drift=0.1, burn=0.4):
+    return {"tile": {"timeline": {
+        "samples": 40, "span_s": 20.0, "dropped_samples": 0,
+        "ex_per_sec": {"first_q": 100.0, "last_q": 90.0,
+                       "drift_frac": drift},
+        "slo": {"rss_slope": {"series": "proc/rss_bytes",
+                              "kind": "slope", "bound": 8.0,
+                              "burn": burn, "violations": 0,
+                              "samples": 40}}}}}
+
+
+def test_slo_drift_violation_fails(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _timeline(drift=0.9)))
+    r = _run("--dir", d, "--slo")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "tile.timeline.ex_per_sec.drift_frac" in r.stderr
+    assert "--max-drift" in r.stderr
+    # the knob relaxes the absolute ceiling
+    assert _run("--dir", d, "--slo", "--max-drift",
+                "0.95").returncode == 0
+
+
+def test_slo_burn_violation_fails(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _timeline(burn=3.2)))
+    r = _run("--dir", d, "--slo")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "tile.timeline.slo.rss_slope.burn" in r.stderr
+    assert "--max-burn" in r.stderr
+
+
+def test_slo_healthy_timeline_passes(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _timeline()))
+    _write_run(d, 2, _parsed(99_000.0, _timeline(drift=0.2, burn=0.8)))
+    r = _run("--dir", d, "--slo")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # only the NEWEST run is gated: an old bad run doesn't fail now
+    _write_run(d, 0, _parsed(100_000.0, _timeline(drift=0.9)))
+    assert _run("--dir", d, "--slo").returncode == 0
+
+
+def test_slo_missing_timeline_skipped_with_note(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0))     # pre-timeline snapshot
+    r = _run("--dir", d, "--slo")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "--slo gate skipped" in r.stdout
+
+
+def test_slo_off_by_default(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _timeline(drift=0.9, burn=9.0)))
+    assert _run("--dir", d).returncode == 0

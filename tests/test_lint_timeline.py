@@ -1,0 +1,103 @@
+"""The timeline-series lint (scripts/lint_timeline.py) extends the
+lint_spans single-declaration contract to the telemetry timeline:
+SERIES_TABLE in wormhole_tpu/obs/timeline.py is declared exactly once
+with no duplicate keys, every SLO ``Objective`` series literal resolves
+through it (directly, as a registry metric, or via a ``*suffix``
+derived rule), and every derived-suffix emission and ``record(...)``
+field the sampler stamps is declared."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "lint_timeline.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True)
+
+
+TABLE = ('SERIES_TABLE = {"ts": "field", "mono": "field",\n'
+         '                "rank": "field",\n'
+         '                "ex_per_sec": "gauge",\n'
+         '                "*_p99": "derived"}\n')
+
+
+def _write_tree(root, timeline_body, extra=None):
+    pkg = root / "wormhole_tpu"
+    (pkg / "obs").mkdir(parents=True, exist_ok=True)
+    (pkg / "obs" / "timeline.py").write_text(timeline_body)
+    for name, body in (extra or {}).items():
+        (pkg / name).write_text(body)
+
+
+def test_repo_passes_lint():
+    r = _run("--root", REPO)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_undeclared_objective_series_caught(tmp_path):
+    _write_tree(tmp_path, TABLE, {
+        "slo.py": 'Objective("ok", "ex_per_sec", 0.2)\n'
+                  'Objective("bad", series="renamed/series", bound=1.0)\n'})
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "renamed/series" in r.stderr
+    assert "wormhole_tpu/slo.py:2" in r.stderr
+    assert "ex_per_sec" not in r.stderr
+
+
+def test_series_resolve_through_metrics_and_suffix_rules(tmp_path):
+    _write_tree(tmp_path, TABLE, {
+        # a registry metric name is a valid series as-is, and the
+        # derived rule covers <metric>_p99 for a declared histogram
+        "serve.py": 'reg.gauge("serve/p99_ms")\n'
+                    'reg.histogram("serve/latency_s")\n',
+        "slo.py": 'Objective("a", "serve/p99_ms", 20.0)\n'
+                  'Objective("b", "serve/latency_s_p99", 0.05)\n'})
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 0, r.stderr
+
+
+def test_suffix_rule_needs_known_stem(tmp_path):
+    _write_tree(tmp_path, TABLE, {
+        "slo.py": 'Objective("x", "nonexistent_p99", 1.0)\n'})
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "nonexistent_p99" in r.stderr
+
+
+def test_duplicate_table_key_caught(tmp_path):
+    _write_tree(tmp_path,
+                'SERIES_TABLE = {"ts": "field", "ts": "gauge"}\n')
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "duplicate" in r.stderr and "ts" in r.stderr
+
+
+def test_second_declaration_site_caught(tmp_path):
+    _write_tree(tmp_path, TABLE, {"rogue.py": 'SERIES_TABLE = {}\n'})
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "2 sites" in r.stderr and "rogue.py" in r.stderr
+
+
+def test_undeclared_record_field_and_suffix_caught(tmp_path):
+    _write_tree(
+        tmp_path,
+        TABLE +
+        'rec = registry.record(rank=0, tenant="x")\n'
+        'rec[name + "_rate"] = 0.0\n')
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "tenant" in r.stderr           # undeclared record field
+    assert "'_rate'" in r.stderr          # undeclared derived suffix
+    assert "rank" not in r.stderr.replace("'rank'", "")  # declared ok
+
+
+def test_missing_package_is_distinct_error(tmp_path):
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 2

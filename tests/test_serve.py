@@ -195,9 +195,10 @@ def test_frontend_metrics_through_registry(rng):
         time.sleep(0.2)
     finally:
         fe.close()
-    req_c, depth_g, lat_h = serve_metrics(reg)   # same objects back
+    req_c, depth_g, lat_h, p99_g = serve_metrics(reg)  # same objects back
     assert req_c.value == 6
     assert sum(lat_h.bins) == 6
+    assert p99_g.value > 0.0         # rolling p99 refreshed at flush
     snap = fe._feed.stats()
     assert snap["batches"] >= 2      # DeviceFeed.prepare accounting ran
     assert snap["prep"] > 0 and snap["put"] > 0

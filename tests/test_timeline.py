@@ -1,0 +1,398 @@
+"""Telemetry timeline plane (wormhole_tpu/obs/timeline.py + slo.py +
+flight.py): rolling-window sampler ring/spill/eviction accounting,
+histogram quantile estimation, the two-stamp (ts/mono) record contract
+and cross-rank timeline alignment under wall-clock skew, SLO burn rates
+with deduplicated warnings, and the crash flight recorder's bundle
+dump/dedup/cap — plus the slow chaos e2e: a kill inside the rejoin
+drill leaves a ``flight_*/`` bundle with pre-kill samples."""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from wormhole_tpu.obs import flight as obs_flight
+from wormhole_tpu.obs import merge as obs_merge
+from wormhole_tpu.obs import timeline as obs_timeline
+from wormhole_tpu.obs.flight import FlightRecorder
+from wormhole_tpu.obs.metrics import Registry, merge_snapshots
+from wormhole_tpu.obs.slo import Objective, SLOTracker, default_objectives
+from wormhole_tpu.obs.timeline import (TimelineSampler, read_timeline,
+                                       summarize, timeline_path)
+
+
+@pytest.fixture(autouse=True)
+def _no_flight_hook():
+    """The flight hook is module-global state; leave it disarmed."""
+    obs_flight.uninstall()
+    yield
+    obs_flight.uninstall()
+
+
+# -- Histogram.quantile ------------------------------------------------------
+
+def test_quantile_empty_is_nan_and_bad_q_raises():
+    h = Registry().histogram("lat", buckets=[1.0, 2.0, 4.0])
+    assert math.isnan(h.quantile(0.5))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        h.quantile(-0.1)
+
+
+def test_quantile_linear_interpolation():
+    h = Registry().histogram("lat", buckets=[1.0, 2.0, 4.0])
+    for v in (0.5, 1.5, 3.0, 10.0):      # one per bucket + one past
+        h.observe(v)
+    # target 2 of 4 lands exactly on the (1, 2] bucket's cumulative
+    # edge: full interpolation across that bucket
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    # within the (0, 1] bucket: halfway to the cumulative count of 1
+    assert h.quantile(0.125) == pytest.approx(0.5)
+    # mass past the last finite bound clamps to it (Prometheus +Inf)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+
+
+def test_quantile_skips_empty_buckets():
+    h = Registry().histogram("lat", buckets=[1.0, 2.0, 4.0])
+    h.observe(0.5)
+    h.observe(10.0)                      # bins = [1, 0, 0], +Inf = 1
+    assert h.quantile(0.5) == pytest.approx(1.0)
+    assert h.quantile(0.9) == pytest.approx(4.0)   # clamp
+
+
+# -- Registry.record two-stamp contract --------------------------------------
+
+def test_record_carries_wall_and_mono_stamps():
+    reg = Registry()
+    reg.counter("c").inc(3)
+    rec = reg.record(rank=1)
+    assert abs(rec["ts"] - time.time()) < 5.0
+    assert abs(rec["mono"] - time.monotonic()) < 5.0
+    assert rec["rank"] == 1 and rec["c"] == 3.0
+    # caller extras override the stamps (heartbeat passes its own
+    # sampled-together pair) ...
+    assert reg.record(mono=5.0, ts=7.0) == \
+        {"mono": 5.0, "ts": 7.0, "c": 3.0}
+    # ... but registry metric values are written last and win
+    assert reg.record(c=99.0)["c"] == 3.0
+
+
+# -- merge_snapshots: missing / extra keys -----------------------------------
+
+def test_merge_snapshots_missing_and_extra_keys():
+    a, b = Registry(), Registry()
+    a.counter("shared").inc(2)
+    a.gauge("only_a").set(1.5)
+    a.histogram("lat", buckets=[1.0, 4.0]).observe(0.5)
+    b.counter("shared").inc(3)
+    b.counter("only_b").inc(5)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged.get("shared").value == 5.0
+    # a key missing from one snapshot merges as that host's value alone
+    assert merged.get("only_a").value == 1.5
+    assert merged.get("only_b").value == 5.0
+    assert merged.get("lat").count == 1
+    # order independence: extra-first then missing
+    swapped = merge_snapshots([b.snapshot(), a.snapshot()])
+    assert swapped.get("shared").value == 5.0
+    assert swapped.get("only_a").value == 1.5
+
+
+# -- TimelineSampler ---------------------------------------------------------
+
+def test_sampler_derives_rates_and_quantiles_and_phase():
+    reg = Registry()
+    work = reg.counter("work/items")
+    lat = reg.histogram("lat", buckets=[1.0, 2.0, 4.0])
+    s = TimelineSampler(registry=reg, interval_s=0.01, rank=3)
+    s.set_phase("train:pass0")
+    s.sample_once()
+    work.inc(50)
+    lat.observe(1.5)
+    time.sleep(0.02)
+    rec = s.sample_once()
+    assert rec["rank"] == 3 and rec["seq"] == 1
+    assert rec["phase"] == "train:pass0"
+    assert "ts" in rec and "mono" in rec
+    assert rec["work/items_rate"] > 0.0          # counter -> rate
+    assert rec["lat_p50"] == pytest.approx(1.5)  # histogram -> quantile
+    assert "lat_p99" in rec
+
+
+def test_sampler_ring_eviction_accounting():
+    reg = Registry()
+    s = TimelineSampler(registry=reg, ring=4)
+    for _ in range(7):
+        s.sample_once()
+    assert len(s.samples()) == 4
+    assert s.dropped() == 3
+    assert reg.get("timeline/dropped_samples").value == 3.0
+    # the counter is snapshotted into the record *before* that sample's
+    # own append can evict, so the newest sample reads one behind
+    assert summarize(s.samples())["dropped_samples"] == 2
+
+
+def test_sampler_spill_is_atomic_and_read_is_torn_tolerant(tmp_path):
+    reg = Registry()
+    reg.counter("c").inc(1)
+    path = timeline_path(str(tmp_path), rank=2)
+    assert path.endswith("host2.timeline.jsonl")
+    s = TimelineSampler(registry=reg, path=path)
+    for _ in range(3):
+        s.sample_once()
+    assert s.spill() == path
+    assert not os.path.exists(path + ".tmp")
+    rows = read_timeline(path)
+    assert [r["seq"] for r in rows] == [0, 1, 2]
+    with open(path, "a") as f:
+        f.write('{"torn": ')             # crash mid-line
+    assert len(read_timeline(path)) == 3
+
+
+def test_sampler_window_and_feed_progress():
+    reg = Registry()
+    s = TimelineSampler(registry=reg)
+    s.feed_progress(1, 100)
+    time.sleep(0.02)
+    s.feed_progress(2, 300)
+    rec = s.sample_once()
+    assert rec["progress/step"] == 2.0
+    assert rec["ex_per_sec"] > 0.0       # 200 ex over ~0.02s
+    now = time.monotonic()
+    assert s.window(60.0, now=now) == s.samples()
+    assert s.window(0.0, now=now + 1.0) == []
+
+
+def test_sampler_thread_spills_and_stop_is_final(tmp_path):
+    reg = Registry()
+    path = timeline_path(str(tmp_path), rank=0)
+    s = TimelineSampler(registry=reg, interval_s=0.02, path=path,
+                        spill_itv_s=0.0).start()
+    time.sleep(0.15)
+    s.stop()
+    rows = read_timeline(path)
+    assert rows and rows == s.samples()
+    assert all("proc/rss_bytes" in r for r in rows)
+
+
+def test_sampler_overhead_is_small():
+    reg = Registry()
+    for i in range(20):                  # a realistically busy registry
+        reg.counter(f"c{i}").inc(i)
+    s = TimelineSampler(registry=reg, interval_s=1.0)
+    n = 50
+    for _ in range(n):
+        s.sample_once()
+    # the <=2% ex/s overhead acceptance at the default 1s cadence:
+    # one tick must cost well under 20ms; leave 10x headroom for CI
+    assert s.tick_s / n < 0.002, f"mean tick {s.tick_s / n * 1e3:.2f}ms"
+
+
+def test_summarize_drift_and_rss_slope():
+    mk = lambda i, exs, rss: {"mono": float(i), "ex_per_sec": exs,
+                              "proc/rss_bytes": rss,
+                              "timeline/dropped_samples": 0}
+    # throughput decays 100 -> 50; RSS grows 1 MiB/s
+    samples = [mk(i, 100.0 - 6.25 * i, (1 + i) * (1 << 20))
+               for i in range(9)]
+    out = summarize(samples)
+    assert out["samples"] == 9 and out["span_s"] == 8.0
+    assert out["ex_per_sec"]["first_q"] > out["ex_per_sec"]["last_q"]
+    assert out["ex_per_sec"]["drift_frac"] == pytest.approx(0.4516, abs=0.01)
+    assert out["rss"]["slope_mb_per_min"] == pytest.approx(60.0)
+    assert summarize([]) == {"samples": 0}
+
+
+# -- cross-rank timeline alignment (clock model) -----------------------------
+
+def test_merge_timelines_aligns_skewed_wall_clocks(tmp_path):
+    d = str(tmp_path)
+
+    def write(rank, rows):
+        with open(timeline_path(d, rank), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    # both ranks share a monotonic clock (launch_mp: one machine) but
+    # rank 1's wall clock is 100s ahead — sorting by raw ts would push
+    # every rank-1 sample after all of rank 0's
+    write(0, [{"rank": 0, "mono": m, "ts": 1000.0 + m}
+              for m in (10.0, 11.0, 12.0)])
+    write(1, [{"rank": 1, "mono": m, "ts": 1100.0 + m}
+              for m in (10.5, 11.5)])
+    out = obs_merge.merge_timelines(d)
+    assert out is not None
+    path, report = out
+    assert report["ranks"] == [0, 1] and report["samples"] == 5
+    merged = read_timeline(path)
+    assert [s["rank"] for s in merged] == [0, 1, 0, 1, 0]
+    # unified stamps use the base rank's offset for every rank
+    assert [s["uts"] for s in merged] == \
+        [1010.0, 1010.5, 1011.0, 1011.5, 1012.0]
+    # idempotent: the merged output is not re-ingested as a rank file
+    assert obs_merge.merge_timelines(d)[1]["samples"] == 5
+
+
+def test_merge_timelines_empty_dir_is_none(tmp_path):
+    assert obs_merge.merge_timelines(str(tmp_path)) is None
+    assert obs_merge.merge_timelines("") is None
+
+
+# -- SLO burn rates ----------------------------------------------------------
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        Objective("x", "s", 1.0, kind="banana")
+    with pytest.raises(ValueError):
+        Objective("x", "s", 0.0)
+    objs = default_objectives(serve_p99_ms=20.0, rss_mb_per_min=8.0)
+    assert [o.name for o in objs] == ["serve_p99", "rss_slope"]
+    assert default_objectives() == []
+
+
+def test_ceiling_burn_and_window_trim():
+    o = Objective("p99", "serve/p99_ms", 10.0, kind="ceiling",
+                  budget_frac=0.25)
+    trk = SLOTracker([o], window_s=30.0, sink=lambda m: None)
+    for i, v in enumerate([5.0, 15.0, 5.0, 15.0]):   # half violating
+        trk.observe({"mono": 100.0 + i, "serve/p99_ms": v})
+    assert trk.burn(o) == pytest.approx(0.5 / 0.25)  # 2x budget
+    # points older than the window fall out
+    trk.observe({"mono": 200.0, "serve/p99_ms": 5.0})
+    assert trk.report()["p99"]["samples"] == 1
+    assert trk.burn(o) == 0.0                        # <2 points left
+
+
+def test_drift_and_slope_burns():
+    d = Objective("exs", "ex_per_sec", 0.25, kind="drift")
+    s = Objective("rss", "proc/rss_bytes", 2.0, kind="slope")
+    trk = SLOTracker([d, s], window_s=600.0, sink=lambda m: None)
+    for i in range(8):
+        trk.observe({"mono": float(i),
+                     "ex_per_sec": 100.0 - 6.25 * i,    # ~44% decay
+                     "proc/rss_bytes": i * (1 << 20)})  # 1 MiB/s
+    # quartile means: first (100, 93.75), last (62.5, 56.25)
+    assert trk.burn(d) == pytest.approx(0.3871 / 0.25, abs=0.05)
+    assert trk.burn(s) == pytest.approx(60.0 / 2.0)  # MB/min over bound
+    rep = trk.report()
+    assert rep["exs"]["kind"] == "drift" and rep["rss"]["burn"] > 1.0
+
+
+def test_slo_warnings_are_deduped_with_recovery():
+    lines = []
+    o = Objective("p99", "serve/p99_ms", 10.0, kind="ceiling",
+                  budget_frac=0.1)
+    trk = SLOTracker([o], window_s=5.0, sink=lines.append,
+                     rewarn_after=1e9)
+    for i in range(6):                   # every sample violating
+        trk.observe({"mono": float(i), "serve/p99_ms": 50.0})
+    opened = [m for m in lines if "burning" in m]
+    assert len(opened) == 1              # one warning, then silence
+    assert "p99" in opened[0] and "incident #1" in opened[0]
+    assert trk.report()["p99"]["violations"] == 1
+    for i in range(6, 12):               # back under the ceiling
+        trk.observe({"mono": float(i), "serve/p99_ms": 1.0})
+    assert any("recovered" in m for m in lines)
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def _armed(tmp_path, n_samples=5):
+    reg = Registry()
+    reg.counter("work/items").inc(7)
+    s = TimelineSampler(registry=reg, interval_s=0.01)
+    for _ in range(n_samples):
+        s.sample_once()
+    rec = FlightRecorder(str(tmp_path / "flight"), sampler=s,
+                         window_s=3600.0, rank=1)
+    return reg, s, rec
+
+
+def test_flight_bundle_contents(tmp_path, capsys):
+    reg, s, rec = _armed(tmp_path)
+    bdir = rec.dump("chaos_kill", step=6, note="planted")
+    assert os.path.basename(bdir) == "flight_chaos_kill_6"
+    rows = read_timeline(os.path.join(bdir, "timeline.jsonl"))
+    assert len(rows) == 5                # the whole window
+    with open(os.path.join(bdir, "registry.json")) as f:
+        snap = json.load(f)
+    assert snap["work/items"]["value"] == 7.0
+    with open(os.path.join(bdir, "flight.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"] == "chaos_kill" and meta["step"] == 6
+    assert meta["rank"] == 1 and meta["timeline_samples"] == 5
+    assert "[flight] flight_chaos_kill_6" in capsys.readouterr().err
+
+
+def test_flight_dedup_cap_and_sanitize(tmp_path):
+    _reg, _s, rec = _armed(tmp_path)
+    rec.max_dumps = 2
+    first = rec.dump("peer lost @3")     # sanitized directory name
+    assert os.path.basename(first) == "flight_peer_lost__3"
+    assert rec.dump("peer lost @3") == ""        # per-reason dedup
+    assert rec.dump("drain") != ""
+    assert rec.dump("other") == ""               # global cap
+    assert set(rec.bundles()) == {"peer_lost__3", "drain"}
+
+
+def test_flight_module_hook_is_noop_until_installed(tmp_path):
+    assert obs_flight.record("anything") == ""
+    _reg, _s, rec = _armed(tmp_path)
+    obs_flight.install(rec)
+    assert obs_flight.installed() is rec
+    assert obs_flight.record("watchdog", step=3) != ""
+    obs_flight.uninstall()
+    assert obs_flight.record("watchdog2") == ""
+
+
+def test_flight_recorder_without_sampler_still_dumps(tmp_path):
+    reg = Registry()
+    reg.gauge("g").set(2.0)
+    rec = FlightRecorder(str(tmp_path), registry=reg)
+    bdir = rec.dump("bare")
+    assert not os.path.exists(os.path.join(bdir, "timeline.jsonl"))
+    with open(os.path.join(bdir, "registry.json")) as f:
+        assert json.load(f)["g"]["value"] == 2.0
+
+
+# -- chaos e2e: a kill leaves a flight bundle --------------------------------
+
+@pytest.mark.slow
+def test_chaos_kill_leaves_flight_bundle(tmp_path):
+    """Planted SIGKILL inside the rejoin drill: the supervisor observes
+    the dead rank via heartbeat staleness and the installed recorder
+    dumps a ``flight_dead_rank2/`` bundle holding the pre-kill timeline
+    window and a final registry snapshot."""
+    from wormhole_tpu.ft.drill import run_rejoin_drill
+
+    reg = Registry()
+    sampler = TimelineSampler(registry=reg, interval_s=0.1,
+                              ring=4096).start()
+    sampler.set_phase("drill")
+    rec = FlightRecorder(str(tmp_path / "flight"), sampler=sampler,
+                         window_s=3600.0)
+    obs_flight.install(rec)
+    try:
+        rep = run_rejoin_drill(str(tmp_path / "run"), kill=(2, 4),
+                               rejoin=False, ckpt_every=2,
+                               serve_qps=20.0, registry=reg)
+    finally:
+        obs_flight.uninstall()
+        sampler.stop()
+    assert rep["kill"] is not None and rep["kill"]["rank"] == 2
+
+    bundles = rec.bundles()
+    assert "dead_rank2" in bundles, bundles
+    bdir = bundles["dead_rank2"]
+    rows = read_timeline(os.path.join(bdir, "timeline.jsonl"))
+    assert rows, "bundle holds no timeline samples"
+    # seconds of pre-kill telemetry: samples that predate detection
+    with open(os.path.join(bdir, "flight.json")) as f:
+        meta = json.load(f)
+    pre = [r for r in rows if r["mono"] <= meta["mono"]]
+    assert len(pre) >= 3, f"{len(pre)} pre-kill samples"
+    assert all(r["phase"] == "drill" for r in rows)
+    assert os.path.exists(os.path.join(bdir, "registry.json"))

@@ -138,6 +138,11 @@ def tick_block(n: int = 1) -> None:
         sys.stderr.write(
             f"[ft] chaos: SIGKILL rank {_RANK} at block {p['kill_block']}\n")
         sys.stderr.flush()
+        try:    # SIGKILL is uncatchable: flight-record before it lands
+            from ..obs import flight
+            flight.record("chaos_kill", step=p["kill_block"])
+        except BaseException:
+            pass
         os.kill(os.getpid(), signal.SIGKILL)
 
 

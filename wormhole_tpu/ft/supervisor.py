@@ -153,11 +153,24 @@ class Supervisor:
         if code not in BYSTANDER_CODES:
             self.dead.add(rank)
             self.epoch += 1
+            self._flight(f"rank{rank}_rc{code}")
 
     def record_dead(self, ranks: Iterable[int]) -> None:
         fresh = {int(r) for r in ranks} - self.dead
         self.dead.update(fresh)
         self.epoch += len(fresh)
+        for r in sorted(fresh):
+            self._flight(f"dead_rank{r}")
+
+    @staticmethod
+    def _flight(reason: str) -> None:
+        """Supervisor-observed deaths are a failure edge the dead child
+        can't report itself — dump the observer's flight bundle."""
+        try:
+            from ..obs import flight
+            flight.record(reason)
+        except BaseException:
+            pass
 
     def scan_heartbeats(self, heartbeat_dir: str,
                         now: Optional[float] = None) -> List[int]:

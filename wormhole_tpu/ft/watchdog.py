@@ -70,6 +70,11 @@ class CollectiveWatchdog:
             f"{self.timeout_s:.1f}s — peer presumed lost; "
             f"exiting with PEER_LOST ({PEER_LOST})\n")
         sys.stderr.flush()
+        try:    # os._exit skips every exporter: flight-record first
+            from ..obs import flight
+            flight.record(f"peer_lost_{site}")
+        except BaseException:
+            pass
         os._exit(PEER_LOST)
 
     def arm(self, site: str) -> None:

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from wormhole_tpu import obs
+from wormhole_tpu.obs import flight as obs_flight
 from wormhole_tpu.data.feed import next_bucket, nnz_bucket, pad_to_batch
 from wormhole_tpu.ft import chaos as ft_chaos
 from wormhole_tpu.ft import supervisor as ft_supervisor
@@ -1058,6 +1059,7 @@ class AsyncSGD:
         completed = start_pass
         drained = False
         for data_pass in range(start_pass, cfg.max_data_pass):
+            self.obs.set_phase(f"train:pass{data_pass}")
             self.pool.clear()
             self.pool.add(cfg.train_data, cfg.num_parts_per_file, TRAIN)
             wd_before = self.progress.wdelta2
@@ -1080,6 +1082,7 @@ class AsyncSGD:
                 self.progress.merge(self.flush_metrics())
                 log.info("drain requested: abandoning pass %d at a part "
                          "boundary (completed=%d)", data_pass, completed)
+                obs_flight.record("drain", step=completed)
                 break
             tail = self.flush_metrics()
             self.progress.merge(tail)
@@ -1771,6 +1774,7 @@ class AsyncSGD:
         try:
             try:
                 for data_pass in range(start_pass, cfg.max_data_pass):
+                    self.obs.set_phase(f"multihost:pass{data_pass}")
                     prog = (self._multihost_pass_crec(cfg.train_data,
                                                       TRAIN)
                             if crec
@@ -1817,6 +1821,7 @@ class AsyncSGD:
                 log.info("drain requested: abandoning pass at a block "
                          "boundary; committing survivor checkpoint v%d",
                          completed)
+                obs_flight.record("drain_interrupt", step=completed)
                 if ckpt is not None and completed:
                     self.ckpt_version = completed
                     ckpt.save(completed, self.store.state_pytree(),
@@ -1916,6 +1921,7 @@ class AsyncSGD:
         pass). The per-minibatch mean AUC stays in Progress for display; the
         pooled number is the unbiased pass-level statistic."""
         from wormhole_tpu.ops.metrics import auc_np
+        self.obs.set_phase("eval")
         pool = WorkloadPool()
         pool.add(pattern, self.cfg.num_parts_per_file, VAL)
         total = Progress()
@@ -1943,6 +1949,7 @@ class AsyncSGD:
         otherwise."""
         if not out_path:
             raise ValueError("test_data set but pred_out empty")
+        self.obs.set_phase("predict")
         if self.cfg.serve_predict and hasattr(self.store,
                                               "build_serve_margin"):
             from wormhole_tpu.serve import ForwardStep
@@ -1991,7 +1998,7 @@ class AsyncSGD:
     def _display(self, local: Progress) -> None:
         # heartbeat BEFORE the rank gate: every host reports its own
         # liveness/throughput, that is the point of straggler detection
-        if self.obs.hb is not None and self.obs.hb.due():
+        if self.obs.tick_due():
             snap = Progress(self.progress.fvec + local.fvec,
                             self.progress.ivec + local.ivec)
             self.obs.heartbeat_tick(

@@ -30,15 +30,21 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from . import trace, metrics, heartbeat
+from . import trace, metrics, heartbeat, timeline as timeline_mod
+from . import flight as flight_mod
 from .metrics import Registry, default_registry, merge_snapshots
 from .heartbeat import (HeartbeatWriter, HeartbeatMonitor,
                         StragglerDetector, read_heartbeats)
+from .timeline import TimelineSampler
+from .slo import SLOTracker, default_objectives
+from .flight import FlightRecorder
 
 __all__ = ["trace", "metrics", "heartbeat", "Obs", "setup",
            "Registry", "default_registry", "merge_snapshots",
            "HeartbeatWriter", "HeartbeatMonitor", "StragglerDetector",
-           "read_heartbeats", "METRICS_EXPORT_ENV", "TRACE_EXPORT_ENV"]
+           "read_heartbeats", "TimelineSampler", "SLOTracker",
+           "default_objectives", "FlightRecorder",
+           "METRICS_EXPORT_ENV", "TRACE_EXPORT_ENV"]
 
 # launch_mp exports this so workers inherit the launcher's heartbeat
 # directory without every config file naming one
@@ -63,13 +69,21 @@ class Obs:
 
     def __init__(self, rank: int = 0, trace_path: str = "",
                  metrics_export: str = "", heartbeat_itv: float = 5.0,
-                 registry: Optional[Registry] = None) -> None:
+                 registry: Optional[Registry] = None,
+                 sample_itv_s: float = 0.0, timeline_ring: int = 512,
+                 timeline_spill_itv_s: float = 10.0,
+                 slo: Optional[SLOTracker] = None,
+                 flight_dir: str = "",
+                 flight_window_s: float = 30.0) -> None:
         self.rank = rank
         self.trace_path = _rank_path(trace_path, rank) if trace_path else ""
         self.export_dir = metrics_export
         self.registry = registry if registry is not None \
             else default_registry()
         self.hb: Optional[HeartbeatWriter] = None
+        self.sampler: Optional[TimelineSampler] = None
+        self.slo = slo
+        self.flight: Optional[FlightRecorder] = None
         if self.trace_path:
             trace.enable(self.trace_path, pid=rank)
         if self.export_dir:
@@ -79,15 +93,48 @@ class Obs:
                                           registry=self.registry)
             except OSError:
                 self.hb = None
+        if sample_itv_s > 0:
+            path = timeline_mod.timeline_path(self.export_dir, rank) \
+                if self.export_dir else ""
+            obs_list = [slo.observe] if slo is not None else []
+            self.sampler = TimelineSampler(
+                registry=self.registry, interval_s=sample_itv_s,
+                path=path, ring=timeline_ring,
+                spill_itv_s=timeline_spill_itv_s, rank=rank,
+                observers=obs_list).start()
+        if flight_dir:
+            self.flight = FlightRecorder(
+                flight_dir, sampler=self.sampler,
+                registry=self.registry, window_s=flight_window_s,
+                rank=rank)
+            flight_mod.install(self.flight)
 
     @property
     def active(self) -> bool:
-        return bool(self.trace_path or self.export_dir)
+        return bool(self.trace_path or self.export_dir
+                    or self.sampler is not None
+                    or self.flight is not None)
+
+    def set_phase(self, label: str) -> None:
+        """Tag timeline samples with the active phase; free when the
+        sampler is off."""
+        if self.sampler is not None:
+            self.sampler.set_phase(label)
+
+    def tick_due(self) -> bool:
+        """Whether :meth:`heartbeat_tick` has anything to do right now
+        (heartbeat writer due, or the timeline sampler needs a live
+        throughput point)."""
+        return (self.hb is not None and self.hb.due()) \
+            or self.sampler is not None
 
     def heartbeat_tick(self, step: int, num_ex: int,
                        feed_stall: float = 0.0, **extra) -> None:
         """Rate-limited heartbeat from the learner's display cadence;
-        free when metrics_export is unset."""
+        also refreshes the timeline sampler's live ex/s gauge. Free
+        when both are off."""
+        if self.sampler is not None:
+            self.sampler.feed_progress(step, num_ex)
         if self.hb is not None:
             self.hb.beat(step, num_ex, feed_stall, **extra)
 
@@ -109,6 +156,11 @@ class Obs:
         Prometheus dump, and a final heartbeat. Never raises into the
         caller."""
         try:
+            if self.sampler is not None:
+                self.sampler.stop()       # final ring spill
+            if self.flight is not None and flight_mod.installed() \
+                    is self.flight:
+                flight_mod.uninstall()    # clean run: disarm
             self.ingest(timer=timer, progress=progress,
                         feed_stats=feed_stats)
             if self.trace_path:
@@ -163,8 +215,23 @@ def setup(cfg, rank: int = 0,
         trace_dir = os.environ.get(TRACE_EXPORT_ENV, "")
         if trace_dir:
             trace_path = os.path.join(trace_dir, "trace.json")
+    objectives = default_objectives(
+        serve_p99_ms=getattr(cfg, "slo_serve_p99_ms", 0.0),
+        exs_drift_frac=getattr(cfg, "slo_exs_drift_frac", 0.0),
+        ps_staleness=getattr(cfg, "slo_ps_staleness", 0.0),
+        rss_mb_per_min=getattr(cfg, "slo_rss_mb_per_min", 0.0))
+    slo = SLOTracker(objectives,
+                     window_s=getattr(cfg, "slo_window_s", 60.0)) \
+        if objectives else None
     return Obs(rank=rank,
                trace_path=trace_path,
                metrics_export=export,
                heartbeat_itv=getattr(cfg, "heartbeat_itv", 5.0),
-               registry=registry)
+               registry=registry,
+               sample_itv_s=getattr(cfg, "metrics_sample_itv_s", 0.0),
+               timeline_ring=getattr(cfg, "timeline_ring", 512),
+               timeline_spill_itv_s=getattr(
+                   cfg, "timeline_spill_itv_s", 10.0),
+               slo=slo,
+               flight_dir=getattr(cfg, "flight_dir", ""),
+               flight_window_s=getattr(cfg, "flight_window_s", 30.0))

@@ -1,0 +1,174 @@
+"""Crash flight recorder: post-mortems that survive not reaching
+``Obs.finalize``.
+
+Every terminal path in the repo used to lose its telemetry: the
+watchdog ``os._exit(PEER_LOST)``s, chaos SIGKILLs the process,
+``DrainInterrupt`` unwinds past the exporters, a drain-thread exception
+surfaces on the trainer, and a supervisor that merely *observes* a
+child die holds no telemetry for it at all. The
+:class:`FlightRecorder` subscribes to exactly those failure edges and,
+on the first firing, dumps a bundle directory::
+
+    <out_dir>/flight_<reason>_<step>/
+        flight.json     reason, note, step, rank, wall/mono stamps
+        timeline.jsonl  the last ``window_s`` seconds of samples
+        trace.json      the live trace ring (obs/trace.py events)
+        registry.json   a final registry snapshot
+
+The module-level ``install()/record()`` pair is the same global-hook
+pattern ft/chaos.py uses: producers call :func:`record` unconditionally
+and it is a no-op until a recorder is installed, so ft/ and ps/ stay
+importable (and silent) when observability is off. ``record`` never
+raises — it runs on paths that are already dying.
+
+Bundle writes go through the same tmp+fsync+rename discipline as the
+timeline spill where it matters (the dump may be racing an
+``os._exit``), and each (reason) dumps at most once per process with a
+global cap, so a crash loop cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["FlightRecorder", "install", "installed", "record",
+           "uninstall"]
+
+_LOCK = threading.Lock()
+_RECORDER: Optional["FlightRecorder"] = None
+
+
+def install(rec: Optional["FlightRecorder"]) -> None:
+    """Install the process-wide recorder (None to disarm)."""
+    global _RECORDER
+    with _LOCK:
+        _RECORDER = rec
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def installed() -> Optional["FlightRecorder"]:
+    return _RECORDER
+
+
+def record(reason: str, step: int = -1, note: str = "") -> str:
+    """Fire the installed recorder; no-op ("" path) when none is armed.
+    Safe to call from any thread and from paths about to ``_exit`` —
+    never raises."""
+    rec = _RECORDER
+    if rec is None:
+        return ""
+    try:
+        return rec.dump(reason, step=step, note=note)
+    except BaseException:
+        return ""
+
+
+def _sanitize(reason: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in reason)[:64] or "unknown"
+
+
+class FlightRecorder:
+    """Dumps timeline window + trace ring + registry snapshot on a
+    failure edge.
+
+    Parameters
+    ----------
+    out_dir: bundle parent directory (created on first dump).
+    sampler: TimelineSampler to pull the rolling window from (None:
+        the bundle just has no timeline.jsonl).
+    registry: Registry for the final snapshot (defaults to the
+        sampler's registry when present).
+    window_s: seconds of timeline to keep in the bundle.
+    rank: stamped into flight.json.
+    max_dumps: process-wide bundle cap; one bundle per distinct reason.
+    """
+
+    def __init__(self, out_dir: str, sampler=None, registry=None,
+                 window_s: float = 30.0, rank: int = 0,
+                 max_dumps: int = 4) -> None:
+        self.out_dir = out_dir
+        self.sampler = sampler
+        self.registry = registry
+        if registry is None and sampler is not None:
+            self.registry = sampler.registry
+        self.window_s = float(window_s)
+        self.rank = int(rank)
+        self.max_dumps = int(max_dumps)
+        self._lock = threading.Lock()
+        self._dumped: dict = {}       # reason -> bundle path
+
+    def dump(self, reason: str, step: int = -1, note: str = "") -> str:
+        """Write one bundle; dedups per reason, never raises. Returns
+        the bundle path ("" when deduped/capped/failed)."""
+        reason = _sanitize(reason)
+        with self._lock:
+            if reason in self._dumped:
+                return ""
+            if len(self._dumped) >= self.max_dumps:
+                return ""
+            self._dumped[reason] = ""     # reserve before the slow part
+        try:
+            path = self._dump(reason, step, note)
+            self._dumped[reason] = path
+            return path
+        except BaseException:
+            return ""
+
+    def bundles(self) -> dict:
+        with self._lock:
+            return dict(self._dumped)
+
+    # -- internals ---------------------------------------------------
+
+    def _dump(self, reason: str, step: int, note: str) -> str:
+        tag = f"flight_{reason}_{step}" if step >= 0 else \
+            f"flight_{reason}"
+        bdir = os.path.join(self.out_dir, tag)
+        os.makedirs(bdir, exist_ok=True)
+
+        meta = {"reason": reason, "step": step, "note": note,
+                "rank": self.rank, "ts": round(time.time(), 3),
+                "mono": round(time.monotonic(), 4),
+                "window_s": self.window_s}
+        if self.sampler is not None:
+            win = self.sampler.window(self.window_s)
+            meta["timeline_samples"] = len(win)
+            with open(os.path.join(bdir, "timeline.jsonl"), "w") as f:
+                for s in win:
+                    f.write(json.dumps(s) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        try:
+            from . import trace
+            evs = trace.events()
+            if evs:
+                trace.write_trace(os.path.join(bdir, "trace.json"), evs)
+                meta["trace_events"] = len(evs)
+        except Exception:
+            pass
+        if self.registry is not None:
+            self._commit_json(os.path.join(bdir, "registry.json"),
+                              self.registry.snapshot())
+        self._commit_json(os.path.join(bdir, "flight.json"), meta)
+        print(f"[flight] {tag}: bundle at {bdir}",
+              file=__import__("sys").stderr, flush=True)
+        return bdir
+
+    @staticmethod
+    def _commit_json(path: str, obj) -> None:
+        """tmp + fsync + rename (parallel/checkpoint.py discipline):
+        the dump may be racing an os._exit on another thread."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

@@ -22,7 +22,7 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["HeartbeatWriter", "read_heartbeats", "StragglerDetector",
-           "HeartbeatMonitor"]
+           "HeartbeatMonitor", "IncidentLog"]
 
 
 def heartbeat_path(directory: str, rank: int) -> str:
@@ -168,17 +168,66 @@ class StragglerDetector:
                 for r, rate in sorted(rates.items()) if rate < floor]
 
 
+class IncidentLog:
+    """Per-key warn/recover deduplication shared by HeartbeatMonitor
+    (straggler incidents) and obs/slo.py's SLOTracker (objective burn
+    incidents): a key that crosses into violation opens an incident and
+    emits ONCE; while the incident is open it stays silent
+    (``rewarn_after`` is the escape hatch — a "still violating"
+    reminder for very long incidents); recovery closes the incident
+    with one line, and a later relapse opens incident #2 with a fresh
+    warning."""
+
+    def __init__(self, sink=None, rewarn_after: float = 60.0) -> None:
+        self.rewarn_after = rewarn_after
+        self._sink = sink
+        # key -> open incident {"n": ordinal, "t0": mono, "warned": mono}
+        self._open: Dict[object, dict] = {}
+        self._count: Dict[object, int] = {}
+
+    def update(self, key, active: bool, describe,
+               now: Optional[float] = None) -> str:
+        """Advance one key. ``describe(event, inc, now)`` renders the
+        log line for event in {"open", "still", "recover"}; ``inc`` is
+        the incident dict (n/t0/warned). Returns the event emitted, or
+        "" when the transition was silent."""
+        if now is None:
+            now = time.monotonic()
+        inc = self._open.get(key)
+        if not active:
+            if inc is None:
+                return ""
+            del self._open[key]
+            self.emit(describe("recover", inc, now))
+            return "recover"
+        if inc is None:
+            n = self._count.get(key, 0) + 1
+            self._count[key] = n
+            inc = {"n": n, "t0": now, "warned": now}
+            self._open[key] = inc
+            self.emit(describe("open", inc, now))
+            return "open"
+        if now - inc["warned"] >= self.rewarn_after:
+            inc["warned"] = now
+            self.emit(describe("still", inc, now))
+            return "still"
+        return ""
+
+    def open_keys(self):
+        return set(self._open)
+
+    def emit(self, msg: str) -> None:
+        if self._sink is not None:
+            self._sink(msg)
+        else:
+            import sys
+            print(msg, file=sys.stderr, flush=True)
+
+
 class HeartbeatMonitor:
     """Launcher-side aggregator: a daemon thread that scans a heartbeat
-    directory every ``interval`` seconds and logs straggler warnings.
-
-    Warnings are deduplicated per (rank, incident): a rank that crosses
-    the floor opens an incident and warns ONCE; while the incident is
-    open it stays silent (``rewarn_after`` is the escape hatch — a
-    "still straggling" reminder for very long incidents); when the rank
-    climbs back above the floor (or finishes) the incident closes with a
-    recovery line, and a later relapse opens incident #2 with a fresh
-    warning."""
+    directory every ``interval`` seconds and logs straggler warnings,
+    deduplicated per (rank, incident) by :class:`IncidentLog`."""
 
     def __init__(self, directory: str, factor: float = 3.0,
                  interval: float = 5.0, sink=None,
@@ -186,11 +235,7 @@ class HeartbeatMonitor:
         self.dir = directory
         self.detector = StragglerDetector(factor)
         self.interval = interval
-        self.rewarn_after = rewarn_after
-        self._sink = sink
-        # rank -> open incident {"n": ordinal, "t0": mono, "warned": mono}
-        self._incidents: Dict[int, dict] = {}
-        self._incident_count: Dict[int, int] = {}
+        self.incidents = IncidentLog(sink=sink, rewarn_after=rewarn_after)
         self._stop = None
         self._thread = None
 
@@ -198,46 +243,34 @@ class HeartbeatMonitor:
         by_rank = read_heartbeats(self.dir)
         flags = self.detector.check(by_rank)
         now = time.monotonic()
-        flagged = {f["rank"] for f in flags}
-        for r in list(self._incidents):
-            if r in flagged:
-                continue
-            inc = self._incidents.pop(r)
-            recs = by_rank.get(r) or [{}]
-            last = recs[-1]
-            state = ("finished" if last.get("final") else
-                     f"back above floor at "
-                     f"{float(last.get('ex_per_sec', 0.0)):.0f} ex/s")
-            self._emit(
-                f"[launcher] recovered: w{r} {state} "
-                f"(incident #{inc['n']}, {now - inc['t0']:.0f}s)")
-        for f in flags:
-            r = f["rank"]
-            inc = self._incidents.get(r)
-            if inc is None:
-                n = self._incident_count.get(r, 0) + 1
-                self._incident_count[r] = n
-                self._incidents[r] = {"n": n, "t0": now, "warned": now}
-                self._emit(
-                    f"[launcher] straggler: w{r} at "
-                    f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
-                    f"(median {f['median']:.0f}, factor "
-                    f"{self.detector.factor}, incident #{n})")
-            elif now - inc["warned"] >= self.rewarn_after:
-                inc["warned"] = now
-                self._emit(
-                    f"[launcher] straggler: w{r} still at "
-                    f"{f['ex_per_sec']:.0f} ex/s < floor {f['floor']} "
-                    f"({now - inc['t0']:.0f}s into incident "
-                    f"#{inc['n']})")
-        return flags
+        by_flag = {f["rank"]: f for f in flags}
+        for r in self.incidents.open_keys() | set(by_flag):
+            f = by_flag.get(r)
 
-    def _emit(self, msg: str) -> None:
-        if self._sink is not None:
-            self._sink(msg)
-        else:
-            import sys
-            print(msg, file=sys.stderr, flush=True)
+            def describe(event, inc, now, r=r, f=f):
+                if event == "recover":
+                    recs = by_rank.get(r) or [{}]
+                    last = recs[-1]
+                    state = ("finished" if last.get("final") else
+                             f"back above floor at "
+                             f"{float(last.get('ex_per_sec', 0.0)):.0f}"
+                             f" ex/s")
+                    return (f"[launcher] recovered: w{r} {state} "
+                            f"(incident #{inc['n']}, "
+                            f"{now - inc['t0']:.0f}s)")
+                if event == "open":
+                    return (f"[launcher] straggler: w{r} at "
+                            f"{f['ex_per_sec']:.0f} ex/s < floor "
+                            f"{f['floor']} (median {f['median']:.0f}, "
+                            f"factor {self.detector.factor}, "
+                            f"incident #{inc['n']})")
+                return (f"[launcher] straggler: w{r} still at "
+                        f"{f['ex_per_sec']:.0f} ex/s < floor "
+                        f"{f['floor']} ({now - inc['t0']:.0f}s into "
+                        f"incident #{inc['n']})")
+
+            self.incidents.update(r, f is not None, describe, now=now)
+        return flags
 
     def start(self) -> "HeartbeatMonitor":
         import threading

@@ -36,10 +36,13 @@ from typing import Dict, List, Optional, Tuple
 from .heartbeat import read_heartbeats
 
 __all__ = ["load_rank_traces", "clock_offsets", "merge_traces",
-           "merge_run", "latest_attempt_dir"]
+           "merge_run", "merge_timelines", "latest_attempt_dir"]
 
 MERGED_TRACE = "merged.trace.json"
 SKEW_REPORT = "skew_report.json"
+MERGED_TIMELINE = "merged.timeline.jsonl"
+
+_TIMELINE_FILE = re.compile(r"^host(\d+)\.timeline\.jsonl$")
 
 _ATTEMPT_DIR = re.compile(r"^attempt(\d+)$")
 
@@ -208,6 +211,72 @@ def merge_traces(docs: Dict[int, dict],
               "metadata": {"merged": True, "ranks": sorted(docs),
                            "dropped_spans": dropped}}
     return merged, report
+
+
+def merge_timelines(export_dir: str, out_path: str = ""
+                    ) -> Optional[Tuple[str, dict]]:
+    """Merge per-rank ``host<rank>.timeline.jsonl`` spills (the
+    obs/timeline.py sampler rings) onto one wall timeline using the
+    same heartbeat clock model as :func:`merge_traces`: each sample
+    carries both ``ts`` and ``mono`` (the ``Registry.record``
+    contract), each rank's wall offset is ``median(ts - mono)`` over
+    its heartbeats — falling back to the samples themselves when a
+    rank has no heartbeats — and every sample gets a unified ``uts`` =
+    ``mono + offsets[base_rank]`` so cross-host wall skew cannot
+    reorder the merged series. Writes ``merged.timeline.jsonl`` sorted
+    by ``uts``; returns ``(path, report)`` or None when no rank spilled
+    a timeline."""
+    export_dir = latest_attempt_dir(export_dir)
+    if not export_dir or not os.path.isdir(export_dir):
+        return None
+    by_rank: Dict[int, List[dict]] = {}
+    for name in sorted(os.listdir(export_dir)):
+        m = _TIMELINE_FILE.match(name)
+        if not m:
+            continue
+        rows: List[dict] = []
+        try:
+            with open(os.path.join(export_dir, name)) as f:
+                for line in f:
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        if rows:
+            by_rank[int(m.group(1))] = rows
+    if not by_rank:
+        return None
+    offsets = clock_offsets(read_heartbeats(export_dir))
+    # a rank with no heartbeats still aligns through its own samples
+    # (same two-stamp contract, just fewer records to median over)
+    for rank, rows in by_rank.items():
+        if rank not in offsets:
+            offsets.update(clock_offsets({rank: rows}))
+    usable = [r for r in sorted(by_rank) if r in offsets]
+    base_rank = usable[0] if usable else None
+    merged: List[dict] = []
+    for rank, rows in by_rank.items():
+        for s in rows:
+            s = dict(s)
+            if base_rank is not None and "mono" in s:
+                s["uts"] = round(float(s["mono"]) + offsets[base_rank], 3)
+            else:
+                s["uts"] = float(s.get("ts", 0.0))
+            merged.append(s)
+    merged.sort(key=lambda s: s["uts"])
+    out_path = out_path or os.path.join(export_dir, MERGED_TIMELINE)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for s in merged:
+            f.write(json.dumps(s) + "\n")
+    os.replace(tmp, out_path)
+    report = {"ranks": sorted(by_rank), "samples": len(merged),
+              "clock_source": ("heartbeat" if base_rank is not None
+                               else "wall_ts"),
+              "merged_timeline": out_path}
+    return out_path, report
 
 
 def _write_json(path: str, doc: dict) -> str:

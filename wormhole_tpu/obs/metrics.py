@@ -120,6 +120,28 @@ class Histogram:
         self.count += 1
         self.sum += v
 
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 <= q <= 1) by linear interpolation
+        over the bucket bounds — the histogram_quantile() model, so the
+        estimate stays mergeable across ranks (unlike an exact
+        reservoir). Returns NaN when empty; observations past the last
+        finite bound clamp to it, as Prometheus does for +Inf."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q}: want 0 <= q <= 1")
+        if self.count == 0:
+            return float("nan")
+        target = q * self.count
+        cum = 0
+        for i, upper in enumerate(self.buckets):
+            prev_cum, cum = cum, cum + self.bins[i]
+            if cum >= target:
+                lower = self.buckets[i - 1] if i else 0.0
+                if self.bins[i] == 0:
+                    return upper
+                frac = (target - prev_cum) / self.bins[i]
+                return lower + (upper - lower) * frac
+        return self.buckets[-1]
+
     def snapshot(self):
         return {"buckets": list(self.buckets), "bins": list(self.bins),
                 "count": self.count, "sum": self.sum}
@@ -308,8 +330,14 @@ class Registry:
 
     def record(self, **extra) -> dict:
         """One JSON-lines heartbeat record: flat name->value dict (hist
-        as count/sum) plus caller extras (rank, step, rates...)."""
-        out = {"ts": round(time.time(), 3)}
+        as count/sum) plus caller extras (rank, step, rates...). Carries
+        both wall ``ts`` and monotonic ``mono`` so obs/merge.py's clock
+        model (offset = median(ts - mono)) can align records cross-rank;
+        caller extras override those stamps (heartbeat passes its own
+        ts/mono pair, sampled together), while registry metric values
+        are written last and win over a same-named extra."""
+        out = {"ts": round(time.time(), 3),
+               "mono": round(time.monotonic(), 4)}
         out.update(extra)
         for name in self.names():
             m = self._metrics[name]

@@ -1,0 +1,191 @@
+"""SLO objectives + rolling burn rates over the telemetry timeline.
+
+An :class:`Objective` declares a bound on one timeline series; the
+:class:`SLOTracker` subscribes to the sampler (``observers=[trk.observe]``)
+and keeps a rolling window per objective, from which it computes a
+**burn rate** — how fast the objective's error budget is being spent,
+normalized so burn <= 1.0 is within budget and burn > 1.0 means the
+budget exhausts before the window does (the Google SRE workbook model,
+folded onto three bound kinds):
+
+- ``ceiling``: burn = (fraction of window samples above ``bound``)
+  divided by ``budget_frac`` (the tolerated violation fraction).
+- ``drift``: first-vs-last-quartile decay of the series over the
+  window; burn = drift_frac / bound.
+- ``slope``: least-squares slope of the series (per minute, in MB for
+  byte series); burn = slope / bound — the RSS-leak detector.
+
+Warnings are deduplicated per objective through the same
+:class:`~wormhole_tpu.obs.heartbeat.IncidentLog` machinery the
+launcher's straggler monitor uses: one warning when an objective
+starts burning (burn >= ``warn_burn``), silence while the incident is
+open, a recovery line when it closes.
+
+The default objective set mirrors the config knobs (all off until the
+knob is set): serve p99 ceiling, ex/s drift bound, ps staleness
+ceiling, host-RSS slope. Series names resolve through
+``timeline.SERIES_TABLE`` — enforced by scripts/lint_timeline.py.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .heartbeat import IncidentLog
+
+__all__ = ["Objective", "SLOTracker", "default_objectives"]
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declared bound on one timeline series."""
+
+    name: str                 # short handle, e.g. "serve_p99"
+    series: str               # timeline series the objective reads
+    bound: float              # ceiling / max drift frac / max slope
+    kind: str = "ceiling"     # "ceiling" | "drift" | "slope"
+    budget_frac: float = 0.05  # ceiling: tolerated violation fraction
+
+    def __post_init__(self):
+        if self.kind not in ("ceiling", "drift", "slope"):
+            raise ValueError(f"objective {self.name}: "
+                             f"unknown kind {self.kind!r}")
+        if self.bound <= 0:
+            raise ValueError(f"objective {self.name}: bound must be > 0")
+
+
+def default_objectives(serve_p99_ms: float = 0.0,
+                       exs_drift_frac: float = 0.0,
+                       ps_staleness: float = 0.0,
+                       rss_mb_per_min: float = 0.0) -> List[Objective]:
+    """The stock objective set, one per config knob; a zero knob leaves
+    that objective undeclared."""
+    objs: List[Objective] = []
+    if serve_p99_ms > 0:
+        objs.append(Objective("serve_p99", "serve/p99_ms",
+                              serve_p99_ms, kind="ceiling"))
+    if exs_drift_frac > 0:
+        objs.append(Objective("exs_drift", "ex_per_sec",
+                              exs_drift_frac, kind="drift"))
+    if ps_staleness > 0:
+        objs.append(Objective("ps_staleness", "ps/staleness",
+                              ps_staleness, kind="ceiling"))
+    if rss_mb_per_min > 0:
+        objs.append(Objective("rss_slope", "proc/rss_bytes",
+                              rss_mb_per_min, kind="slope"))
+    return objs
+
+
+def _slope_per_min(pts: List) -> float:
+    """Least-squares slope in units/minute over (mono, value) points."""
+    n = len(pts)
+    if n < 2 or pts[-1][0] <= pts[0][0]:
+        return 0.0
+    mt = sum(p[0] for p in pts) / n
+    mv = sum(p[1] for p in pts) / n
+    num = sum((p[0] - mt) * (p[1] - mv) for p in pts)
+    den = sum((p[0] - mt) ** 2 for p in pts)
+    return (num / den) * 60.0 if den else 0.0
+
+
+class SLOTracker:
+    """Rolling burn-rate computation + deduped warnings.
+
+    Feed it samples via :meth:`observe` (wire as a sampler observer);
+    read the current state via :meth:`burns` or the summary
+    :meth:`report` bench.py embeds in the per-phase timeline block.
+    """
+
+    def __init__(self, objectives: List[Objective],
+                 window_s: float = 60.0, warn_burn: float = 1.0,
+                 sink=None, rewarn_after: float = 60.0) -> None:
+        self.objectives = list(objectives)
+        self.window_s = float(window_s)
+        self.warn_burn = float(warn_burn)
+        self.incidents = IncidentLog(sink=sink,
+                                     rewarn_after=rewarn_after)
+        # objective name -> deque of (mono, value)
+        self._pts: Dict[str, deque] = {o.name: deque()
+                                       for o in self.objectives}
+        self._violations: Dict[str, int] = {o.name: 0
+                                            for o in self.objectives}
+
+    # -- ingestion ---------------------------------------------------
+
+    def observe(self, sample: dict) -> None:
+        """Ingest one timeline sample; never raises into the sampler."""
+        now = sample.get("mono")
+        if now is None:
+            now = time.monotonic()
+        for o in self.objectives:
+            v = sample.get(o.series)
+            if v is None:
+                continue
+            pts = self._pts[o.name]
+            pts.append((float(now), float(v)))
+            cut = now - self.window_s
+            while pts and pts[0][0] < cut:
+                pts.popleft()
+        self._warn(now)
+
+    # -- burn rates --------------------------------------------------
+
+    def burn(self, o: Objective) -> float:
+        pts = self._pts[o.name]
+        if len(pts) < 2:
+            return 0.0
+        if o.kind == "ceiling":
+            bad = sum(1 for p in pts if p[1] > o.bound)
+            return (bad / len(pts)) / o.budget_frac
+        if o.kind == "drift":
+            vals = [p[1] for p in pts]
+            q = max(1, len(vals) // 4)
+            first = sum(vals[:q]) / q
+            last = sum(vals[-q:]) / q
+            drift = (first - last) / first if first > 0 else 0.0
+            return max(0.0, drift) / o.bound
+        slope = _slope_per_min(list(pts))
+        if o.series.endswith("_bytes"):
+            slope /= float(1 << 20)       # bound is MB/min
+        return max(0.0, slope) / o.bound
+
+    def burns(self) -> Dict[str, float]:
+        return {o.name: round(self.burn(o), 4) for o in self.objectives}
+
+    def report(self) -> dict:
+        """Per-objective summary for the bench timeline block."""
+        out: dict = {}
+        for o in self.objectives:
+            out[o.name] = {
+                "series": o.series, "kind": o.kind, "bound": o.bound,
+                "burn": round(self.burn(o), 4),
+                "violations": self._violations[o.name],
+                "samples": len(self._pts[o.name])}
+        return out
+
+    # -- warnings ----------------------------------------------------
+
+    def _warn(self, now: float) -> None:
+        for o in self.objectives:
+            if len(self._pts[o.name]) < 4:
+                continue      # don't judge a window of two points
+            b = self.burn(o)
+            burning = b >= self.warn_burn
+
+            def describe(event, inc, t, o=o, b=b):
+                if event == "recover":
+                    return (f"[slo] recovered: {o.name} burn back "
+                            f"under {self.warn_burn:g} (incident "
+                            f"#{inc['n']}, {t - inc['t0']:.0f}s)")
+                verb = "burning" if event == "open" else "still burning"
+                return (f"[slo] {o.name} {verb}: burn {b:.2f} >= "
+                        f"{self.warn_burn:g} ({o.kind} on {o.series}, "
+                        f"bound {o.bound:g}, incident #{inc['n']})")
+
+            ev = self.incidents.update(o.name, burning, describe,
+                                       now=now)
+            if ev == "open":
+                self._violations[o.name] += 1

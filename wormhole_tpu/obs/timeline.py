@@ -1,0 +1,369 @@
+"""Rolling-window telemetry timeline: a low-overhead daemon sampler.
+
+Everything else in obs/ is produced once, at run end (registry
+snapshot, Prometheus text, ledger attribution, trace merge) — which
+structurally hides drift, leaks, and p99 decay inside a run, and loses
+all of it when the run dies. The parameter-server deployments this repo
+reproduces were monitored as long-lived services with continuous
+scrape; this module is the equivalent time-series plane.
+
+:class:`TimelineSampler` snapshots the metric :class:`Registry` every
+``metrics_sample_itv_s`` seconds on a daemon thread, converts counters
+to rates (delta / dt) and gauges to points, tags the sample with the
+active phase label, and appends it to a bounded in-memory ring.
+Periodically — and always on :meth:`stop` — the ring is spilled to a
+per-rank ``timeline.jsonl`` with the same fsync-before-rename
+discipline as parallel/checkpoint.py: write a temp file, fsync, then
+``os.replace`` so a reader (or a post-mortem after SIGKILL) never sees
+a torn file. Ring eviction is accounted in the
+``timeline/dropped_samples`` counter, mirroring ``trace.dropped()``.
+
+Each sample carries both wall ``ts`` and monotonic ``mono`` (the
+contract ``Registry.record`` provides) so obs/merge.py's heartbeat
+clock model can align timelines cross-rank.
+
+``SERIES_TABLE`` below is the single declaration site for the series
+names the timeline plane itself emits and the SLO tracker reads —
+enforced by scripts/lint_timeline.py, the same contract lint_spans.py
+applies to span names. Registry metric names flow through unchanged
+(their single-site rule is lint_knobs'); derived series append the
+``_rate`` suffix declared here.
+
+Module level stays stdlib-only (obs/ must import without jax); the jax
+device-memory probe only runs when jax is already loaded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["SERIES_TABLE", "TimelineSampler", "system_gauges",
+           "timeline_path", "read_timeline", "summarize"]
+
+# Single declaration site for timeline-plane series names
+# (scripts/lint_timeline.py): "field" = per-sample record fields the
+# sampler stamps, "gauge"/"counter" = metrics the timeline subsystem
+# itself declares in the registry, "derived*" = suffix rule for series
+# computed from registry metrics (counter -> <name>_rate, histogram ->
+# <name>_p50/_p99 via Histogram.quantile).
+SERIES_TABLE: Dict[str, str] = {
+    "ts": "field",            # wall-clock seconds (time.time)
+    "mono": "field",          # monotonic seconds (clock-model anchor)
+    "seq": "field",           # per-rank sample ordinal
+    "rank": "field",          # emitting rank
+    "phase": "field",         # active phase/tenant label ("" = untagged)
+    "proc/rss_bytes": "gauge",        # host VmRSS (/proc, psutil-free)
+    "device/mem_bytes": "gauge",      # jax device bytes_in_use, if any
+    "ex_per_sec": "gauge",            # live throughput (feed_progress)
+    "progress/step": "gauge",         # last step seen by feed_progress
+    "timeline/dropped_samples": "counter",   # ring evictions
+    "*_rate": "derived",      # counter delta / sample dt
+    "*_p50": "derived",       # Histogram.quantile(0.5)
+    "*_p99": "derived",       # Histogram.quantile(0.99)
+}
+
+_FIELDS = frozenset(k for k, v in SERIES_TABLE.items() if v == "field")
+
+
+def timeline_path(directory: str, rank: int) -> str:
+    """Per-rank timeline file, mirroring heartbeat_path's naming."""
+    return os.path.join(directory, f"host{rank}.timeline.jsonl")
+
+
+def system_gauges(reg):
+    """Declare (single site) and return the host/device memory gauges
+    the sampler refreshes each tick — the leak signals the soak phase
+    gates on."""
+    return (reg.gauge("proc/rss_bytes",
+                      help="host resident set size from "
+                           "/proc/self/status VmRSS (psutil-free)"),
+            reg.gauge("device/mem_bytes",
+                      help="jax device bytes_in_use on the first local "
+                           "device, when jax is loaded and the backend "
+                           "reports memory_stats"))
+
+
+def read_rss_bytes() -> float:
+    """VmRSS from /proc/self/status, in bytes; 0.0 where /proc is
+    unavailable (macOS) — a flat zero line, never an exception."""
+    try:
+        with open("/proc/self/status", "r") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def read_device_mem_bytes() -> float:
+    """bytes_in_use on the first local jax device, 0.0 when jax is not
+    already imported (never force the import) or the backend has no
+    memory_stats (CPU)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0.0
+    try:
+        devs = jax.local_devices()
+        stats = devs[0].memory_stats() if devs else None
+        if stats:
+            return float(stats.get("bytes_in_use", 0.0))
+    except Exception:
+        pass
+    return 0.0
+
+
+class TimelineSampler:
+    """Daemon thread turning the registry into a bounded time series.
+
+    Parameters
+    ----------
+    registry: the Registry to snapshot (default_registry() when None).
+    interval_s: seconds between samples (the metrics_sample_itv_s knob).
+    path: spill destination; "" keeps the ring memory-only (bench mode).
+    ring: max samples held; older samples are evicted and counted in
+        timeline/dropped_samples.
+    spill_itv_s: min seconds between periodic ring spills (<=0 disables
+        periodic spill; stop() always spills when a path is set).
+    rank: stamped into every sample.
+    observers: callables fed each sample as it lands (the SLOTracker
+        subscription point); observer errors are swallowed — telemetry
+        must never kill training.
+    """
+
+    def __init__(self, registry=None, interval_s: float = 1.0,
+                 path: str = "", ring: int = 512,
+                 spill_itv_s: float = 10.0, rank: int = 0,
+                 observers: Optional[list] = None) -> None:
+        if registry is None:
+            from .metrics import default_registry
+            registry = default_registry()
+        self.registry = registry
+        self.interval_s = max(0.01, float(interval_s))
+        self.path = path
+        self.spill_itv_s = float(spill_itv_s)
+        self.rank = int(rank)
+        self.observers = list(observers or [])
+        self._ring: deque = deque(maxlen=max(2, int(ring)))
+        self._dropped = registry.counter(
+            "timeline/dropped_samples",
+            help="timeline ring samples evicted before spill "
+                 "(mirrors trace/dropped_spans)")
+        self._sys = system_gauges(registry)
+        self._phase = ""
+        self._seq = 0
+        # cumulative seconds spent inside sample_once — the measured
+        # sampler overhead bench.py reports as a fraction of phase wall
+        self.tick_s = 0.0
+        self._prev: Dict[str, float] = {}
+        self._prev_mono = 0.0
+        self._prog_mono = 0.0
+        self._prog_ex = 0
+        self._last_spill = 0.0
+        self._lock = threading.Lock()
+        self._stop_ev: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- phase tagging -----------------------------------------------
+
+    def set_phase(self, label: str) -> None:
+        """Tag subsequent samples with the active phase/tenant label."""
+        self._phase = str(label)
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    def feed_progress(self, step: int, num_ex: int) -> None:
+        """Refresh the live throughput series from the learner's
+        display cadence (Obs.heartbeat_tick): the heartbeat writer's
+        delta-rate computation, landing in an ``ex_per_sec`` gauge the
+        sampler and the drift SLO objective can read continuously."""
+        now = time.monotonic()
+        step_g = self.registry.gauge(
+            "progress/step", help="last step seen by the timeline "
+                                  "progress feed", agg="max")
+        exs_g = self.registry.gauge(
+            "ex_per_sec", help="examples/s over the last progress-feed "
+                               "delta (timeline/SLO live throughput)")
+        if self._prog_mono:
+            dt = now - self._prog_mono
+            if dt > 0:
+                exs_g.set(max(0.0, num_ex - self._prog_ex) / dt)
+        step_g.set(float(step))
+        self._prog_mono, self._prog_ex = now, int(num_ex)
+
+    # -- sampling ----------------------------------------------------
+
+    def sample_once(self) -> dict:
+        """Take one sample: refresh system gauges, flatten the registry
+        (counters also as _rate, histograms also as _p50/_p99), stamp
+        the timeline fields, append to the ring."""
+        t_tick = time.perf_counter()
+        rss_g, dev_g = self._sys
+        rss_g.set(read_rss_bytes())
+        dev_g.set(read_device_mem_bytes())
+        now_mono = time.monotonic()
+        rec = self.registry.record(rank=self.rank, seq=self._seq,
+                                   phase=self._phase)
+        dt = now_mono - self._prev_mono if self._prev_mono else 0.0
+        for name in self.registry.names():
+            m = self.registry.get(name)
+            if m is None:
+                continue
+            if m.kind == "counter" and dt > 0:
+                delta = rec[name] - self._prev.get(name, rec[name])
+                rec[name + "_rate"] = round(max(0.0, delta) / dt, 6)
+            elif m.kind == "histogram" and m.count:
+                rec[name + "_p50"] = round(m.quantile(0.5), 6)
+                rec[name + "_p99"] = round(m.quantile(0.99), 6)
+        self._prev = {n: rec[n] for n in rec
+                      if n not in _FIELDS
+                      and isinstance(rec[n], (int, float))}
+        self._prev_mono = now_mono
+        self._seq += 1
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped.inc()
+            self._ring.append(rec)
+        for fn in self.observers:
+            try:
+                fn(rec)
+            except Exception:
+                pass
+        self.tick_s += time.perf_counter() - t_tick
+        return rec
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def window(self, seconds: float,
+               now: Optional[float] = None) -> List[dict]:
+        """Samples from the last ``seconds`` (monotonic), newest last —
+        the flight-recorder window."""
+        if now is None:
+            now = time.monotonic()
+        cut = now - seconds
+        return [s for s in self.samples() if s.get("mono", 0.0) >= cut]
+
+    def dropped(self) -> int:
+        return int(self._dropped.value)
+
+    # -- ring spill --------------------------------------------------
+
+    def spill(self, path: str = "") -> str:
+        """Atomically rewrite the ring as JSON lines: temp file, fsync,
+        then rename — the parallel/checkpoint.py ``_commit_bytes``
+        discipline, so a crash mid-spill leaves the previous complete
+        spill in place, never a torn file."""
+        path = path or self.path
+        if not path:
+            return ""
+        rows = self.samples()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._last_spill = time.monotonic()
+        return path
+
+    # -- thread ------------------------------------------------------
+
+    def start(self) -> "TimelineSampler":
+        if self._thread is not None:
+            return self
+        self._stop_ev = threading.Event()
+
+        def loop():
+            while not self._stop_ev.wait(self.interval_s):
+                try:
+                    self.sample_once()
+                    if (self.path and self.spill_itv_s > 0
+                            and time.monotonic() - self._last_spill
+                            >= self.spill_itv_s):
+                        self.spill()
+                except Exception:
+                    pass      # telemetry must never kill the job
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="timeline-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop_ev is not None:
+            self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.path:
+            try:
+                self.spill()
+            except OSError:
+                pass
+
+
+def read_timeline(path: str) -> List[dict]:
+    """Load a spilled timeline; torn-line tolerant like heartbeats."""
+    out: List[dict] = []
+    try:
+        with open(path, "r") as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def summarize(samples: List[dict], slo=None) -> dict:
+    """Digest a sample window into the per-phase ``timeline`` block
+    bench.py embeds in ``--out`` and bench_check.py --slo gates on:
+    sample/drop accounting, first-vs-last-quartile ex/s drift, the RSS
+    slope, and (when an SLOTracker is passed) its burn report."""
+    out: dict = {"samples": len(samples)}
+    if not samples:
+        return out
+    t0 = samples[0].get("mono", 0.0)
+    t1 = samples[-1].get("mono", 0.0)
+    out["span_s"] = round(t1 - t0, 3)
+    out["dropped_samples"] = int(samples[-1].get(
+        "timeline/dropped_samples", 0))
+    rates = [float(s["ex_per_sec"]) for s in samples
+             if "ex_per_sec" in s]
+    if len(rates) >= 4:
+        q = len(rates) // 4
+        first, last = _mean(rates[:q]), _mean(rates[-q:])
+        drift = (first - last) / first if first > 0 else 0.0
+        out["ex_per_sec"] = {"first_q": round(first, 3),
+                             "last_q": round(last, 3),
+                             "drift_frac": round(max(0.0, drift), 4)}
+    rss = [(s.get("mono", 0.0), float(s["proc/rss_bytes"]))
+           for s in samples if s.get("proc/rss_bytes")]
+    if len(rss) >= 2 and rss[-1][0] > rss[0][0]:
+        slope = (rss[-1][1] - rss[0][1]) / (rss[-1][0] - rss[0][0])
+        out["rss"] = {
+            "first_bytes": int(rss[0][1]), "last_bytes": int(rss[-1][1]),
+            "slope_mb_per_min": round(slope * 60.0 / (1 << 20), 4)}
+    if slo is not None:
+        out["slo"] = slo.report()
+    return out

@@ -271,6 +271,8 @@ def launch_mp(n: int, cmd: List[str], heartbeat_dir: str = "",
             monitor.stop()
         if trace_dir:
             _merge_rank_traces(trace_dir, heartbeat_dir, out_lock)
+        if heartbeat_dir:
+            _merge_rank_timelines(heartbeat_dir, out_lock)
     return rc
 
 
@@ -303,6 +305,29 @@ def _merge_rank_traces(trace_dir: str, heartbeat_dir: str,
                  f"lateness {w['lateness_ms']:.1f} ms")
     except Exception as e:
         emit(f"[launcher] trace merge failed: {e!r}")
+
+
+def _merge_rank_timelines(heartbeat_dir: str, out_lock) -> None:
+    """Exit-time aggregation of the ranks' timeline-sampler spills
+    (host<rank>.timeline.jsonl, written when metrics_sample_itv_s > 0)
+    onto one wall timeline via the heartbeat clock model
+    (obs/merge.py). Best-effort and silent when no rank sampled."""
+    def emit(msg: str) -> None:
+        with out_lock:
+            sys.stderr.write(msg + "\n")
+            sys.stderr.flush()
+
+    try:
+        from wormhole_tpu.obs import merge as _merge
+        res = _merge.merge_timelines(heartbeat_dir)
+        if res is None:
+            return
+        path, report = res
+        emit(f"[launcher] merged timeline: {path} "
+             f"({report['samples']} samples from ranks "
+             f"{report['ranks']}, clock: {report['clock_source']})")
+    except Exception as e:
+        emit(f"[launcher] timeline merge failed: {e!r}")
 
 
 def launch_mp_supervised(n: int, cmd: List[str], restarts: int = 0,

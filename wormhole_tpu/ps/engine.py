@@ -126,6 +126,13 @@ class ExchangeEngine:
                     t.result = t.fn()
                 except BaseException as e:  # surfaced on the trainer
                     t.error = e
+                    try:  # the trainer may never collect this ticket
+                        from ..obs import flight
+                        flight.record(
+                            f"drain_{type(e).__name__}", step=t.index,
+                            note=str(e)[:200])
+                    except BaseException:
+                        pass
             dt = time.monotonic() - start
             if t.kind == "delta":
                 self.delays.on_exchange(dt)

@@ -48,8 +48,11 @@ _LAT_WINDOW = 1 << 16
 def serve_metrics(reg):
     """Single declaration site for the serve metric names (the
     lint_knobs unique-name contract): (requests counter, queue-depth
-    gauge, latency histogram). Latency observes SECONDS so the default
-    registry buckets (1ms..100s) apply."""
+    gauge, latency histogram, rolling-p99 gauge). Latency observes
+    SECONDS so the default registry buckets (1ms..100s) apply; the p99
+    gauge is the exact-reservoir tail in MILLISECONDS, refreshed from
+    the flush path so the timeline sampler and the ``serve_p99`` SLO
+    objective see a live point, not an end-of-run summary."""
     return (reg.counter("serve/requests",
                         help="micro-requests answered by the admission "
                              "front-end"),
@@ -59,7 +62,16 @@ def serve_metrics(reg):
             reg.histogram("serve/latency_s",
                           help="per-request serve latency in seconds "
                                "(admission wait + batch build + "
-                               "forward)"))
+                               "forward)"),
+            reg.gauge("serve/p99_ms",
+                      help="rolling p99 serve latency (ms) over the "
+                           "exact-latency reservoir, refreshed at "
+                           "flush time", agg="max"))
+
+
+# min seconds between rolling-p99 recomputations on the flush path —
+# a percentile over the 64Ki reservoir is ~ms, too dear per flush
+_P99_REFRESH_S = 0.5
 
 
 class ServeResult:
@@ -131,6 +143,7 @@ class ServeFrontend:
         if registry is not None:
             self._metrics = serve_metrics(registry)
         self._lat: deque = deque(maxlen=_LAT_WINDOW)
+        self._p99_next = 0.0          # next rolling-p99 refresh (mono)
         self._lock = threading.Lock()
         self._requests = 0
         self._batches = 0
@@ -258,11 +271,17 @@ class ServeFrontend:
             self._deadline_flushes += int(not full)
             self._depth_max = max(self._depth_max, depth)
         if self._metrics is not None:
-            req_c, depth_g, lat_h = self._metrics
+            req_c, depth_g, lat_h, p99_g = self._metrics
             req_c.inc(len(group))
             depth_g.max(depth)
             for v in lats:
                 lat_h.observe(v)
+            if now >= self._p99_next:
+                self._p99_next = now + _P99_REFRESH_S
+                with self._lock:
+                    arr = np.asarray(self._lat, np.float64)
+                if arr.size:
+                    p99_g.set(float(np.percentile(arr, 99)) * 1e3)
 
     # -- batch assembly (DeviceFeed prep stage) ------------------------------
 

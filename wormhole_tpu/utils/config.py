@@ -97,6 +97,32 @@ class Config:
     metrics_export: str = ""
     # min seconds between heartbeat records (obs/heartbeat.py rate limit)
     heartbeat_itv: float = 5.0
+    # timeline sampler interval (obs/timeline.py): > 0 starts the
+    # rolling-window daemon sampler; samples spill to
+    # host<rank>.timeline.jsonl under metrics_export. 0 = off.
+    metrics_sample_itv_s: float = 0.0
+    # max timeline samples held in the in-memory ring; older samples
+    # are evicted into the timeline/dropped_samples counter
+    timeline_ring: int = 512
+    # min seconds between periodic fsync+rename ring spills; the final
+    # spill at finalize always happens. <= 0 = final spill only.
+    timeline_spill_itv_s: float = 10.0
+    # SLO objectives (obs/slo.py; each 0 = that objective undeclared):
+    # rolling serve p99 ceiling in ms
+    slo_serve_p99_ms: float = 0.0
+    # max first-vs-last-quartile ex/s decay fraction over the window
+    slo_exs_drift_frac: float = 0.0
+    # ps/staleness ceiling (windows of delay)
+    slo_ps_staleness: float = 0.0
+    # max host-RSS growth in MB/min (the leak detector)
+    slo_rss_mb_per_min: float = 0.0
+    # rolling window (seconds) burn rates are computed over
+    slo_window_s: float = 60.0
+    # flight recorder (obs/flight.py): non-empty directory arms crash
+    # bundles (flight_<reason>_<step>/) on failure edges. "" = off.
+    flight_dir: str = ""
+    # seconds of pre-failure timeline kept in a flight bundle
+    flight_window_s: float = 30.0
     epsilon: float = 0.0   # early stop when a pass improves per-example
                            # objv by less than this fraction; 0 = off
     max_objv: float = 0.0  # 0 = unset; stop if objv >= max_objv

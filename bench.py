@@ -1654,6 +1654,206 @@ def bench_multichip() -> dict:
     return rec
 
 
+def _bench_hierarchy_inline() -> dict:
+    """The measured hierarchy sweep; needs >= 8 devices in-process."""
+    import threading
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from wormhole_tpu.parallel.mesh import shard_map_compat
+    from wormhole_tpu.obs.metrics import default_registry
+    from wormhole_tpu.parallel.filters import FilterChain
+    from wormhole_tpu.parallel.transport import (
+        BusWire, HierarchicalTransport, MeshTransport, SimBus,
+        TransportStack, ici_ring_bytes)
+    from wormhole_tpu.ps import ExchangeEngine
+
+    devs = jax.devices()
+    nb = 1 << 14          # bucket-space delta width (f32)
+    windows = 40
+    rows_per_window = 4096  # notional examples folded into one delta
+    lr = 0.05
+    out = {"buckets": nb, "windows": windows,
+           "rows_per_window_per_host": rows_per_window,
+           "devices": len(devs)}
+
+    def parse_shape(s):
+        pairs = [tok.split(":") for tok in s.split(",")]
+        return [(name, int(n)) for name, n in pairs]
+
+    configs = []
+    for hosts, shape_s in ((2, "data:2,model:2"), (2, "data:4"),
+                           (4, "data:2")):
+        axes = parse_shape(shape_s)
+        per = int(np.prod([n for _, n in axes]))
+        if hosts * per <= len(devs):
+            configs.append((hosts, shape_s, axes, per))
+    if not configs:
+        raise RuntimeError(
+            f"hierarchy needs >= 8 devices in-process, have {len(devs)}")
+
+    ici_counter = default_registry().counter(
+        "comm/bytes_ici",
+        help="in-mesh collective payload bytes moved over ICI "
+             "(modeled from the dispatched step's psum shapes)")
+
+    for hosts, shape_s, axes, per in configs:
+        tok = "".join(f"{name[0]}{n}" for name, n in axes)
+        names = tuple(name for name, _ in axes)
+        d = dict(axes).get("data", 1)
+        m = dict(axes).get("model", 1)
+        # per-participant ring cost of the step's two psums of the
+        # (nb,) f32 delta — the modeled ICI leg, distinct from the
+        # measured wire leg below
+        ici_b = ici_ring_bytes(4 * nb, d) + ici_ring_bytes(4 * nb, m)
+
+        # one tiny-but-real mesh step per host: each device folds its
+        # own data shard into a bucket-space gradient and the psums
+        # reduce it to the host-level delta inside the compiled step
+        meshes = [Mesh(np.asarray(devs[h * per:(h + 1) * per])
+                       .reshape([n for _, n in axes]), names)
+                  for h in range(hosts)]
+
+        def make_step(mesh):
+            def step(w, x):
+                # nonzero at w=0 so the deltas actually evolve (an
+                # all-zero delta would reduce to cache hits on the wire)
+                g = jnp.tanh(x[0] * (1.0 + w)) / (d * m)
+                for ax in names:
+                    g = jax.lax.psum(g, ax)
+                return g
+            return jax.jit(shard_map_compat(
+                step, mesh, in_specs=(P(), P(names[0])), out_specs=P()))
+
+        steps = [make_step(mesh) for mesh in meshes]
+        rng = np.random.default_rng(11)
+        host_x = [rng.standard_normal((d, nb)).astype(np.float32)
+                  for _ in range(hosts)]
+        # warm the compile cache outside the timed region
+        for h in range(hosts):
+            np.asarray(steps[h](np.zeros(nb, np.float32), host_x[h]))
+
+        for tau in (0, 1):
+            bus = SimBus(hosts)
+            chains = [FilterChain(filters={"key_caching", "fixing_float",
+                                           "compressing"}, quant_bits=8,
+                                  min_bytes=0) for _ in range(hosts)]
+            txs = [HierarchicalTransport(
+                       MeshTransport(site="mesh/step"),
+                       TransportStack(wire=BusWire(bus, h),
+                                      chain=chains[h]),
+                       engine=ExchangeEngine(tau))
+                   for h in range(hosts)]
+            applied = [0] * hosts
+            errs = []
+
+            def run_host(h):
+                try:
+                    w = np.zeros(nb, np.float32)
+                    tx = txs[h]
+                    for _ in range(windows):
+                        delta = tx.local_dispatch(
+                            steps[h], w, host_x[h], ici_bytes=ici_b)
+                        tx.submit_delta(np.asarray(delta))
+                        for tk in tx.gate():
+                            w = w - lr * np.asarray(tk.result)
+                            applied[h] += 1
+                    for tk in tx.quiesce():
+                        w = w - lr * np.asarray(tk.result)
+                        applied[h] += 1
+                except Exception as e:   # surfaced below, not swallowed
+                    errs.append(f"host{h}: {e!r}")
+
+            ici0 = ici_counter.value
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=run_host, args=(h,),
+                                        daemon=True)
+                       for h in range(hosts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            for tx in txs:
+                tx.stop()
+            if errs:
+                raise RuntimeError("; ".join(errs))
+            assert applied == [windows] * hosts
+            raw = sum(c.stats["bytes_raw"] for c in chains)
+            wire = sum(c.stats["bytes_wire"] for c in chains)
+            assert wire > 0, "cross-host leg moved no measured bytes"
+            k = f"h{hosts}_{tok}_tau{tau}"
+            out[f"{k}_ex_per_sec"] = round(
+                windows * rows_per_window * hosts / wall, 1)
+            out[f"{k}_wall_s"] = round(wall, 3)
+            out[f"{k}_bytes_raw"] = raw
+            out[f"{k}_bytes_wire"] = wire
+            out[f"{k}_wire_ratio"] = round(raw / max(wire, 1), 2)
+            out[f"{k}_bytes_ici"] = int(ici_counter.value - ici0)
+            if _deadline_passed():
+                out["budget_truncated"] = True
+                return out
+        base = out.get(f"h{hosts}_{tok}_tau0_ex_per_sec")
+        ov = out.get(f"h{hosts}_{tok}_tau1_ex_per_sec")
+        if base and ov:
+            out[f"h{hosts}_{tok}_tau1_vs_tau0"] = round(ov / base, 3)
+    return out
+
+
+def bench_hierarchy() -> dict:
+    """2D hierarchical exchange (tentpole of the unified-transport PR):
+    H simulated hosts, each an ICI ``(data, model)`` mesh whose step
+    psums the bucket-space delta intra-host, exchanging only host-level
+    deltas cross-host through each host's own quant8+zlib FilterChain
+    over an in-process SimBus — real encoded bytes, measured (not
+    modeled) on the wire leg; the ICI leg is the modeled
+    ``comm/bytes_ici`` ring cost. Sweeps hosts x mesh-shape x tau; like
+    multichip, re-execs with XLA's forced 8-device host platform when
+    this process sees fewer devices."""
+    import jax
+    if len(jax.devices()) >= 8:
+        return _bench_hierarchy_inline()
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="wh_bench_hier_sub_")
+    out_path = os.path.join(workdir, "hier.json")
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    remaining = (_DEADLINE - time.perf_counter()) if _DEADLINE > 0 else 0.0
+    budget = max(120.0, remaining) if remaining > 0 else 600.0
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--phases", "hierarchy", "--out", out_path,
+         "--budget", str(round(budget, 1)), "--no-telemetry"],
+        capture_output=True, text=True, cwd=repo, env=env,
+        timeout=budget + 120.0)
+    try:
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"hierarchy subprocess rc={r.returncode}: "
+                f"{(r.stderr or r.stdout)[-800:]}")
+        with open(out_path) as f:
+            inner = json.load(f)
+        failed = inner.get("extra", {}).get("phases_failed", {})
+        if "hierarchy" in failed:
+            raise RuntimeError(
+                f"hierarchy subprocess phase failed: {failed['hierarchy']}")
+        rec = inner["extra"]["hierarchy"]
+    finally:
+        try:
+            os.remove(out_path)
+            os.rmdir(workdir)
+        except OSError:
+            pass
+    rec["via"] = "subprocess: --xla_force_host_platform_device_count=8 (cpu)"
+    return rec
+
+
 # ordered phase registry; headline phases first so a tight budget still
 # produces the metric. Phases needing the shared tile stores / the crec2
 # file / the text file are tagged so a filtered run only builds what it
@@ -1661,9 +1861,9 @@ def bench_multichip() -> dict:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "tile_fused", "device_sparse",
-          "device_dense_apply", "scale_curve", "multichip", "serve",
-          "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt", "chaos",
-          "rejoin"]
+          "device_dense_apply", "scale_curve", "multichip", "hierarchy",
+          "serve", "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt",
+          "chaos", "rejoin"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
 _STORE_PHASES = {"device_tile", "device_fm", "device_wide_deep",
                  "channel_ratios"}
@@ -1783,6 +1983,10 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
                           for k, v in results[name].items()}
     if "multichip" in results:
         extra["multichip"] = results["multichip"]
+    if "hierarchy" in results:
+        extra["hierarchy"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in results["hierarchy"].items()}
     if "e2e_stream" in results:
         stream = results["e2e_stream"]
         extra["e2e_stream_noncached"] = {
@@ -1908,6 +2112,7 @@ def main(argv=None) -> None:
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
         "multichip": bench_multichip,
+        "hierarchy": bench_hierarchy,
         "serve": bench_serve,
         "comm_filters": bench_comm_filters,
         "async_ps": bench_async_ps,

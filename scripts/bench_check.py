@@ -25,6 +25,13 @@ regresses against its predecessor:
   values (rejoin phase: detection → rejoiner admitted) must stay under
   ``--max-recovery-debt`` — a ceiling, not a trend, because past the
   drill's group timeout the handshake is dead by definition.
+- **Hierarchy wire** (absolute + trend): the NEWEST run's
+  ``hierarchy.*_bytes_wire`` values must be > 0 (the cross-host leg
+  ships real encoded bytes — a zero means the sweep measured nothing)
+  and its ``hierarchy.*_wire_ratio`` values must clear
+  ``--min-wire-ratio``; the same ratio keys also ride the pairwise
+  ``--tol`` machinery (higher is better) so a codec that quietly stops
+  compressing gates like a throughput drop.
 - **SLO timeline** (``--slo``, absolute): the NEWEST run's per-phase
   ``timeline`` blocks (bench.py ``--sample-itv`` sampler;
   ``obs/timeline.summarize``) must keep their first-vs-last-quartile
@@ -84,6 +91,11 @@ _LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
 _SCALE_PAT = re.compile(r"scaling_efficiency$")
 _FUSED_PAT = re.compile(r"fused_over_split$")
 _DEBT_PAT = re.compile(r"recovery_debt_s$")
+# hierarchy-phase wire keys, gated only under the hierarchy block (the
+# comm_filters / async_ps phases carry same-named leaves with different
+# semantics — their payloads are synthetic fixtures, not the 2D sweep)
+_BYTES_WIRE_PAT = re.compile(r"bytes_wire$")
+_WIRE_RATIO_PAT = re.compile(r"wire_ratio$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
 # default --min-scaling: the measured CPU fake-8-device trajectory sits
 # at 0.09-0.13 across the swept shapes (all "devices" share the host
@@ -103,6 +115,11 @@ _MIN_FUSED_RATIO = 1.0
 # a replay path that wedges into its GroupTimeout (the drill's
 # survivors wait 60s before declaring the handshake dead)
 _MAX_RECOVERY_DEBT = 60.0
+# absolute floor on the newest BENCH run's hierarchy.*_wire_ratio: the
+# cross-host delta leg ships quant8+zlib, which measures ~4.2x on the
+# swept dense bucket deltas; 2.0 passes that with headroom while
+# catching a chain that silently degrades to the raw codec (ratio -> 1)
+_MIN_WIRE_RATIO = 2.0
 # --slo defaults: absolute gates over the newest run's per-phase
 # `timeline` blocks (bench.py --sample-itv; obs/timeline.summarize).
 # Drift is the first-vs-last-quartile ex/s decay WITHIN a phase — a
@@ -247,6 +264,17 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
                 f"{key}: {cv:.4f} < {pv:.4f} * {1 - tol:.2f} "
                 f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
                 "multichip scaling efficiency regression")
+    phr, chr_ = (hier_keys(prev, _WIRE_RATIO_PAT),
+                 hier_keys(cur, _WIRE_RATIO_PAT))
+    for key in sorted(set(phr) & set(chr_)):
+        pv, cv = phr[key], chr_[key]
+        if pv <= 0:
+            continue
+        if cv < pv * (1.0 - tol):
+            bad.append(
+                f"{key}: {cv:.2f} < {pv:.2f} * {1 - tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
+                "hierarchy wire compression regression")
     pfracs, cfracs = ledger_fracs(prev), ledger_fracs(cur)
     for key in sorted(set(pfracs) & set(cfracs)):
         if cfracs[key] > pfracs[key] + tol_frac:
@@ -301,6 +329,32 @@ def debt_ceiling(name: str, parsed: dict, max_debt: float) -> List[str]:
         f"({name}) — rejoin recovery debt above the absolute ceiling"
         for key, v in sorted(debt_keys(parsed).items())
         if v > max_debt]
+
+
+def hier_keys(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
+    """``_keys_matching`` restricted to paths under a ``hierarchy``
+    block — the wire gates apply to the 2D sweep only."""
+    return {p: v for p, v in _keys_matching(parsed, pat).items()
+            if ".hierarchy." in f".{p}."}
+
+
+def hier_wire_gate(name: str, parsed: dict,
+                   min_ratio: float) -> List[str]:
+    """Absolute gates on the newest run's hierarchy wire leg: measured
+    bytes on every cross-host config, and a compression-ratio floor —
+    both hard meanings, not trends (zero bytes = the sweep measured
+    nothing; ratio -> 1 = the filter chain stopped compressing)."""
+    bad = [
+        f"{key}: {v:.0f} <= 0 ({name}) — hierarchy cross-host leg "
+        "moved no measured wire bytes"
+        for key, v in sorted(hier_keys(parsed, _BYTES_WIRE_PAT).items())
+        if v <= 0]
+    bad += [
+        f"{key}: {v:.2f} < --min-wire-ratio {min_ratio:.2f} ({name}) "
+        "— hierarchy wire compression below the absolute floor"
+        for key, v in sorted(hier_keys(parsed, _WIRE_RATIO_PAT).items())
+        if v < min_ratio]
+    return bad
 
 
 def timeline_blocks(parsed: dict) -> Dict[str, dict]:
@@ -358,7 +412,8 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      min_scaling: float, min_fused_ratio: float,
                      max_recovery_debt: float, slo: bool = False,
                      max_drift: float = _MAX_DRIFT,
-                     max_burn: float = _MAX_BURN
+                     max_burn: float = _MAX_BURN,
+                     min_wire_ratio: float = _MIN_WIRE_RATIO
                      ) -> Tuple[List[str], int, int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
@@ -369,6 +424,7 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
     if prefix == "BENCH" and runs:
         failures.extend(fused_floor(*runs[-1], min_fused_ratio))
         failures.extend(debt_ceiling(*runs[-1], max_recovery_debt))
+        failures.extend(hier_wire_gate(*runs[-1], min_wire_ratio))
     if slo and runs:
         failures.extend(slo_gate(*runs[-1], max_drift=max_drift,
                                  max_burn=max_burn))
@@ -391,7 +447,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
         min_fused_ratio: float = _MIN_FUSED_RATIO,
         max_recovery_debt: float = _MAX_RECOVERY_DEBT,
         slo: bool = False, max_drift: float = _MAX_DRIFT,
-        max_burn: float = _MAX_BURN) -> int:
+        max_burn: float = _MAX_BURN,
+        min_wire_ratio: float = _MIN_WIRE_RATIO) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
@@ -399,7 +456,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
                                    all_pairs, min_scaling,
                                    min_fused_ratio, max_recovery_debt,
                                    slo=slo, max_drift=max_drift,
-                                   max_burn=max_burn)
+                                   max_burn=max_burn,
+                                   min_wire_ratio=min_wire_ratio)
         failures.extend(f)
         pairs += p
         compared += c
@@ -444,6 +502,12 @@ def main(argv=None) -> int:
                          "BENCH run's *recovery_debt_s (default "
                          f"{_MAX_RECOVERY_DEBT}; rejoin phase, "
                          "detection -> admission)")
+    ap.add_argument("--min-wire-ratio", type=float,
+                    default=_MIN_WIRE_RATIO,
+                    help="absolute floor on the newest BENCH run's "
+                         "hierarchy.*_wire_ratio values (default "
+                         f"{_MIN_WIRE_RATIO}; quant8+zlib measures "
+                         "~4.2x on the swept dense bucket deltas)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
@@ -466,7 +530,8 @@ def main(argv=None) -> int:
                min_fused_ratio=args.min_fused_ratio,
                max_recovery_debt=args.max_recovery_debt,
                slo=args.slo, max_drift=args.max_drift,
-               max_burn=args.max_burn)
+               max_burn=args.max_burn,
+               min_wire_ratio=args.min_wire_ratio)
 
 
 if __name__ == "__main__":

@@ -1,29 +1,37 @@
 #!/usr/bin/env python
-"""Lint: no direct ``multihost_utils`` use outside wormhole_tpu/parallel/,
-and every learners/ collective call site audited for engine routing.
+"""Lint: one transport layer, one marker form.
 
-Rule 1 — every host-level DCN hop must go through
-parallel/collectives.py (``allreduce_tree`` / ``allgather_tree`` /
-``broadcast_tree`` / ``host_local_to_global``): that is where the
-ps-lite filter chain (parallel/filters.py — KEY_CACHING / FIXING_FLOAT
-/ COMPRESSING) and the wire-byte accounting (``comm/bytes_raw`` etc.)
-live. A call site that imports ``jax.experimental.multihost_utils``
-directly bypasses both — its payload ships unfiltered and its bytes
+Rule 1 — raw collective transport lives in exactly ONE file:
+``wormhole_tpu/parallel/transport.py`` (the ``ProcessWire``). Every
+other file in the package — including the rest of ``parallel/`` — must
+reach the wire through the transport stack (``parallel/collectives.py``
+delegates to it). A site that imports ``jax.experimental``'s multihost
+helpers directly bypasses the seq/span stamping, the watchdog guard,
+the ps-lite filter chain (parallel/filters.py — KEY_CACHING /
+FIXING_FLOAT / COMPRESSING) and the wire-byte accounting
+(``comm/bytes_raw`` etc.) — its payload ships unfiltered and its bytes
 vanish from the comm counters — so this lint fails the build until the
 site is rewritten against the wrappers or consciously allowlisted with
 a reason.
 
-Rule 2 — with the bounded-staleness engine (wormhole_tpu/ps/) live, a
-training pass may only issue host collectives from the engine's single
-drain thread: a second thread issuing its own collective can interleave
-differently across ranks and deadlock the mesh. Every
-``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree`` call site
-under ``wormhole_tpu/learners/`` must therefore carry an audit marker
-within the preceding few lines: ``# ps-engine:`` (the call routes
-through ``ExchangeEngine.submit/exchange`` — e.g. via ``_ctl``) or
-``# bsp-direct:`` (the call provably never coexists with a live
-engine, e.g. the crec BSP pass the engine dispatch excludes). An
-unmarked site means nobody decided, which is how the deadlock ships.
+Rule 2 — every collective call site outside ``wormhole_tpu/parallel/``
+(``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree``) must
+carry a single-form routing marker within the preceding few lines::
+
+    # transport: engine — <why this runs on the drain thread>
+    # transport: direct — <why this never coexists with a live engine>
+    # transport: mesh   — <in-jit psum leg; tree call is the fallback>
+
+``engine`` means the call routes through ``ExchangeEngine.submit /
+exchange`` (a second thread issuing its own collective can interleave
+differently across ranks and deadlock the mesh — the engine's single
+drain thread is the only thread allowed to block on the wire while a
+training pass is live). ``direct`` means the call provably never
+coexists with a live engine (BSP passes, startup/shutdown barriers,
+metrics windows the engine quiesces around). ``mesh`` marks a site
+whose hot path is the in-jit ICI psum and the tree call is a host-side
+fallback or reduction of the psum result. An unmarked site means
+nobody decided, which is how the deadlock ships.
 
 The checks are textual (rule 1 strips comments; rule 2 reads them),
 not an AST walk: they must catch lazy function-level imports and
@@ -42,19 +50,27 @@ import os
 import re
 import sys
 
-# Audited files outside parallel/ that legitimately reference
+# The single file allowed to touch the raw wire.
+TRANSPORT_HOME = "wormhole_tpu/parallel/transport.py"
+
+# Audited files outside TRANSPORT_HOME that legitimately reference
 # multihost_utils. Every entry carries the reason. Deliberately EMPTY:
-# the PR that introduced this lint rewrote every call site against the
-# parallel/ wrappers, and new entries should be rare and argued.
+# the PR that unified the transport rewrote every call site against the
+# stack, and new entries should be rare and argued.
 ALLOWLIST: dict = {}
 
 _PAT = re.compile(r"\bmultihost_utils\b")
 
-# rule 2: learners/ collective call sites and their audit markers
+# rule 2: collective call sites and their routing markers
 _CALL_PAT = re.compile(
     r"\b(allreduce_tree|allgather_tree|broadcast_tree)\s*\(")
-_MARKER_PAT = re.compile(r"#\s*(ps-engine|bsp-direct):")
+_MARKER_PAT = re.compile(r"#\s*transport:\s*(\w+)")
+_ROUTES = ("engine", "direct", "mesh")
 _MARKER_WINDOW = 3   # marker may sit up to this many lines above the call
+
+# the retired two-marker form; flagged so stale markers don't linger as
+# dead annotations that LOOK like routing decisions
+_OLD_MARKER_PAT = re.compile(r"#\s*(ps-engine|bsp-direct):")
 
 
 def _strip_comments(text: str) -> str:
@@ -73,22 +89,33 @@ def scan_file(path: str) -> list:
 
 
 def scan_markers(path: str) -> list:
-    """Rule 2: return ``(line, callee)`` for every collective call site
-    without a ``# ps-engine:`` / ``# bsp-direct:`` audit marker on the
-    call line or the :data:`_MARKER_WINDOW` lines above it."""
+    """Rule 2: return ``(line, reason)`` for every collective call site
+    without a valid ``# transport: <route>`` marker on the call line or
+    the :data:`_MARKER_WINDOW` lines above it, plus every stale
+    old-form marker left in the file."""
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         raw = f.read()
     raw_lines = raw.splitlines()
     code_lines = _strip_comments(raw).splitlines()
     out = []
+    for i, ln in enumerate(raw_lines):
+        if _OLD_MARKER_PAT.search(ln):
+            out.append((i + 1, "retired marker form (use `# transport: "
+                               "engine|direct|mesh`)"))
     for i, ln in enumerate(code_lines):
         m = _CALL_PAT.search(ln)
         if m is None:
             continue
         lo = max(0, i - _MARKER_WINDOW)
-        if any(_MARKER_PAT.search(r) for r in raw_lines[lo:i + 1]):
-            continue
-        out.append((i + 1, m.group(1)))
+        marks = [_MARKER_PAT.search(r) for r in raw_lines[lo:i + 1]]
+        marks = [mk for mk in marks if mk is not None]
+        if not marks:
+            out.append((i + 1, f"{m.group(1)} without a `# transport:` "
+                               f"marker"))
+        elif not any(mk.group(1) in _ROUTES for mk in marks):
+            bad = ", ".join(sorted({mk.group(1) for mk in marks}))
+            out.append((i + 1, f"{m.group(1)} marker route {bad!r} not in "
+                               f"{'/'.join(_ROUTES)}"))
     return out
 
 
@@ -108,11 +135,11 @@ def run(root: str) -> int:
                 continue
             path = os.path.join(dirpath, fn)
             rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if rel.startswith("wormhole_tpu/parallel/"):
-                continue  # parallel/ owns the raw transport
-            if rel.startswith("wormhole_tpu/learners/"):
-                unmarked.extend(f"{rel}:{ln} ({name})"
-                                for ln, name in scan_markers(path))
+            if rel == TRANSPORT_HOME:
+                continue  # the one file that owns the raw wire
+            if not rel.startswith("wormhole_tpu/parallel/"):
+                unmarked.extend(f"{rel}:{ln}: {why}"
+                                for ln, why in scan_markers(path))
             lines = scan_file(path)
             if not lines:
                 continue
@@ -126,27 +153,29 @@ def run(root: str) -> int:
         print(f"lint_collectives: allowlist entry {rel} has no "
               f"multihost_utils references (stale?)", file=sys.stderr)
     if violations:
-        print("lint_collectives: direct multihost_utils use outside "
-              "wormhole_tpu/parallel/:", file=sys.stderr)
+        print(f"lint_collectives: raw multihost transport outside "
+              f"{TRANSPORT_HOME}:", file=sys.stderr)
         for v in violations:
             print(f"  {v}", file=sys.stderr)
-        print("route the call through parallel/collectives.py "
-              "(allreduce_tree / allgather_tree / broadcast_tree / "
-              "host_local_to_global) so it rides the filter chain and "
-              "the comm byte counters, or add the file to ALLOWLIST in "
-              "scripts/lint_collectives.py with a reason",
+        print("route the call through the transport stack "
+              "(parallel/collectives.py allreduce_tree / allgather_tree "
+              "/ broadcast_tree / host_local_to_global, or "
+              "parallel/transport.py TransportStack) so it rides the "
+              "layer stack and the comm byte counters, or add the file "
+              "to ALLOWLIST in scripts/lint_collectives.py with a reason",
               file=sys.stderr)
         return 1
     if unmarked:
-        print("lint_collectives: learners/ collective call sites without "
-              "an engine-routing audit marker:", file=sys.stderr)
+        print("lint_collectives: collective call sites without a valid "
+              "routing marker:", file=sys.stderr)
         for v in unmarked:
             print(f"  {v}", file=sys.stderr)
-        print("mark the site `# ps-engine:` (it runs on the exchange "
-              "engine's drain thread — ExchangeEngine.submit/exchange, "
-              "e.g. via AsyncSGD._ctl) or `# bsp-direct:` (it provably "
-              "never coexists with a live engine) within "
-              f"{_MARKER_WINDOW} lines above the call",
+        print("mark the site `# transport: engine` (it runs on the "
+              "exchange engine's drain thread — ExchangeEngine.submit/"
+              "exchange, e.g. via AsyncSGD._ctl), `# transport: direct` "
+              "(it provably never coexists with a live engine) or "
+              "`# transport: mesh` (host-side leg of the in-jit psum "
+              f"path) within {_MARKER_WINDOW} lines above the call",
               file=sys.stderr)
         return 1
     print(f"lint_collectives: OK ({len(seen_allowed)} allowlisted files)")

@@ -380,3 +380,65 @@ def test_slo_off_by_default(tmp_path):
     d = str(tmp_path)
     _write_run(d, 1, _parsed(100_000.0, _timeline(drift=0.9, burn=9.0)))
     assert _run("--dir", d).returncode == 0
+
+
+def _hier(ex=2_000_000.0, wire=1_250_000, ratio=4.2):
+    return {"hierarchy": {"h2_d2m2_tau0_ex_per_sec": ex,
+                          "h2_d2m2_tau0_bytes_wire": wire,
+                          "h2_d2m2_tau0_wire_ratio": ratio}}
+
+
+def test_hierarchy_zero_wire_bytes_fails(tmp_path):
+    """The tentpole acceptance gate: the cross-host leg must MOVE
+    measured bytes — a zero means the sweep exchanged nothing (e.g. a
+    degenerate all-zero delta reducing to cache hits)."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _hier(wire=0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "moved no measured wire bytes" in r.stderr
+
+
+def test_hierarchy_wire_ratio_floor_gates_newest_run(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _hier(ratio=1.1)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-wire-ratio" in r.stderr
+    # the flag relaxes the floor, same machinery as the other absolutes
+    r2 = _run("--dir", d, "--min-wire-ratio", "1.0")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_hierarchy_wire_ratio_trend_rides_tol(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _hier(ratio=4.2)))
+    _write_run(d, 2, _parsed(100_000.0, _hier(ratio=2.1)))  # halved
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "wire compression regression" in r.stderr
+    # within --tol the same pair passes
+    r2 = _run("--dir", d, "--tol", "0.6")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_hierarchy_rate_keys_auto_gated(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _hier(ex=2_000_000.0)))
+    _write_run(d, 2, _parsed(100_000.0, _hier(ex=900_000.0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "h2_d2m2_tau0_ex_per_sec" in r.stderr
+
+
+def test_other_phase_wire_keys_not_hier_gated(tmp_path):
+    """comm_filters / async_ps carry same-named *_bytes_wire /
+    *_wire_ratio leaves on synthetic fixtures — the hierarchy floors
+    must not reach outside the hierarchy block."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"comm_filters": {"bytes_wire": 0,
+                                               "wire_ratio": 1.1},
+                              "async_ps": {"tau0_wire_ratio": 1.05}}))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr

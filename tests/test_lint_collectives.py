@@ -1,7 +1,8 @@
-"""The collectives lint (scripts/lint_collectives.py) guards the filter
-chain: every host DCN hop must enter through parallel/collectives.py so
-it rides the ps-lite filters and the comm byte counters. Direct
-`multihost_utils` use outside wormhole_tpu/parallel/ fails the build."""
+"""The collectives lint (scripts/lint_collectives.py) guards the
+transport layer: raw multihost transport lives only in
+parallel/transport.py, and every collective call site outside
+parallel/ carries a single-form `# transport: <route>` routing marker
+(route in engine/direct/mesh)."""
 
 import os
 import subprocess
@@ -16,6 +17,15 @@ def _run(*args):
                           capture_output=True, text=True)
 
 
+def _mod():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_collectives
+    finally:
+        sys.path.pop(0)
+    return lint_collectives
+
+
 def test_repo_passes_lint():
     r = _run("--root", REPO)
     assert r.returncode == 0, r.stderr
@@ -23,15 +33,12 @@ def test_repo_passes_lint():
 
 
 def test_learners_models_not_allowlisted():
-    # the point of the filters PR: async_sgd/gbdt now go through the
-    # parallel/ wrappers, and the allowlist starts (and should stay) empty
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import lint_collectives
-    finally:
-        sys.path.pop(0)
+    # the point of the transport PR: every call site goes through the
+    # stack, and the allowlist starts (and should stay) empty
+    lint_collectives = _mod()
     assert lint_collectives.ALLOWLIST == {}
-    for rel in ("learners/async_sgd.py", "models/gbdt.py"):
+    for rel in ("learners/async_sgd.py", "models/gbdt.py",
+                "parallel/collectives.py", "parallel/checkpoint.py"):
         assert lint_collectives.scan_file(
             os.path.join(REPO, "wormhole_tpu", *rel.split("/"))) == []
 
@@ -49,10 +56,23 @@ def test_synthetic_violation_caught(tmp_path):
     assert "wormhole_tpu/bad.py:3" in r.stderr
 
 
-def test_unmarked_learner_collective_caught(tmp_path):
-    # rule 2: a learners/ collective call site without a routing marker
-    # fails — nobody decided which thread issues it
-    pkg = tmp_path / "wormhole_tpu" / "learners"
+def test_parallel_non_transport_not_exempt(tmp_path):
+    # rule 1 narrowed: the rest of parallel/ (collectives.py included)
+    # must go through transport.py like everyone else
+    pkg = tmp_path / "wormhole_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "collectives.py").write_text(
+        "from jax.experimental import multihost_utils\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "wormhole_tpu/parallel/collectives.py:1" in r.stderr
+
+
+def test_unmarked_collective_caught(tmp_path):
+    # rule 2: a collective call site without a routing marker fails —
+    # nobody decided which thread issues it. Scope is the whole package
+    # outside parallel/, not just learners/.
+    pkg = tmp_path / "wormhole_tpu" / "obs"
     pkg.mkdir(parents=True)
     (pkg / "bad.py").write_text(
         "from wormhole_tpu.parallel.collectives import allreduce_tree\n"
@@ -60,36 +80,66 @@ def test_unmarked_learner_collective_caught(tmp_path):
         "    return allreduce_tree(x, mesh, 'sum', site='x')\n")
     r = _run("--root", str(tmp_path))
     assert r.returncode == 1
-    assert "learners/bad.py:3 (allreduce_tree)" in r.stderr
-    assert "ps-engine" in r.stderr
+    assert "obs/bad.py:3" in r.stderr
+    assert "# transport:" in r.stderr
 
 
-def test_marked_learner_collective_passes(tmp_path):
-    # both markers satisfy rule 2, on the line or within 3 lines above
+def test_marked_collective_passes(tmp_path):
+    # all three routes satisfy rule 2, on the line or within 3 lines above
     pkg = tmp_path / "wormhole_tpu" / "learners"
     pkg.mkdir(parents=True)
     (pkg / "ok.py").write_text(
         "from wormhole_tpu.parallel.collectives import (allreduce_tree,\n"
-        "                                               allgather_tree)\n"
+        "                                               allgather_tree,\n"
+        "                                               broadcast_tree)\n"
         "def f(x, mesh, eng):\n"
         "    return eng.exchange(\n"
-        "        # ps-engine: control exchange on the drain thread\n"
+        "        # transport: engine — control exchange on the drain thread\n"
         "        lambda: allreduce_tree(x, mesh, 'sum', site='x'))\n"
         "def g(x, mesh):\n"
-        "    # bsp-direct: crec pass never runs with a live engine\n"
-        "    return allgather_tree(x, mesh, site='y')\n")
+        "    # transport: direct — crec pass never runs with a live engine\n"
+        "    return allgather_tree(x, mesh, site='y')\n"
+        "def h(x, mesh):\n"
+        "    # transport: mesh — host-side leg of the in-jit psum path\n"
+        "    return broadcast_tree(x, mesh, root=0, site='z')\n")
     r = _run("--root", str(tmp_path))
     assert r.returncode == 0, r.stderr
     # the import lines are call-free and must not need markers
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import lint_collectives
-    finally:
-        sys.path.pop(0)
-    assert lint_collectives.scan_markers(str(pkg / "ok.py")) == []
+    assert _mod().scan_markers(str(pkg / "ok.py")) == []
 
 
-def test_parallel_dir_is_exempt(tmp_path):
+def test_invalid_route_caught(tmp_path):
+    # a marker with an unknown route is a violation, not a pass: the
+    # vocabulary is closed so grep finds every engine-routed site
+    pkg = tmp_path / "wormhole_tpu" / "models"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "from wormhole_tpu.parallel.collectives import allreduce_tree\n"
+        "def f(x, mesh):\n"
+        "    # transport: sideways — not a real route\n"
+        "    return allreduce_tree(x, mesh, 'sum', site='x')\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "not in engine/direct/mesh" in r.stderr
+
+
+def test_retired_marker_form_caught(tmp_path):
+    # the old two-marker form is flagged even where it would have
+    # satisfied the old lint — stale annotations must not masquerade as
+    # routing decisions
+    pkg = tmp_path / "wormhole_tpu" / "learners"
+    pkg.mkdir(parents=True)
+    (pkg / "stale.py").write_text(
+        "from wormhole_tpu.parallel.collectives import allreduce_tree\n"
+        "def f(x, mesh):\n"
+        "    # ps-engine: control exchange on the drain thread\n"
+        "    return allreduce_tree(x, mesh, 'sum', site='x')\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "retired marker form" in r.stderr
+
+
+def test_only_transport_home_is_exempt(tmp_path):
     pkg = tmp_path / "wormhole_tpu" / "parallel"
     pkg.mkdir(parents=True)
     (pkg / "transport.py").write_text(

@@ -1,0 +1,389 @@
+"""Transport-stack composition: layer-order invariance, tau=0
+bit-parity against the pre-refactor BSP oracle on every path, watchdog
+arming on every path through one shared harness, and the hierarchy
+tau=0 slot-level parity the tentpole promises (ISSUE PR-14)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from wormhole_tpu.ft import watchdog
+from wormhole_tpu.parallel import filters, transport
+from wormhole_tpu.parallel.transport import (
+    AccountingLayer, BusWire, ChaosLayer, Exchange, FilterLayer,
+    HierarchicalTransport, LocalLayer, MeshTransport, SeqLayer, SimBus,
+    SpanLayer, TransportStack, WatchdogLayer, default_layers,
+    ici_ring_bytes, validate_layers,
+)
+from wormhole_tpu.ps.engine import ExchangeEngine
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_transport_state():
+    """Each test gets fresh seq counters, no watchdog, and no global
+    FilterChain; whatever was installed before is restored after."""
+    transport.reset_site_seq()
+    prev_chain = filters.set_chain(None)
+    watchdog.shutdown()
+    yield
+    watchdog.shutdown()
+    filters.set_chain(prev_chain)
+    transport.reset_site_seq()
+
+
+def _lossless_chain():
+    """key_caching + compressing are bit-exact codecs (no fixing_float,
+    so no quantization anywhere)."""
+    return filters.FilterChain(
+        filters={"key_caching", "compressing"}, min_bytes=0)
+
+
+def _run_hosts(hosts, fn):
+    """Run ``fn(host)`` on one thread per simulated host; returns the
+    per-host results in host order, re-raising the first failure."""
+    out, errs = [None] * hosts, []
+
+    def run(h):
+        try:
+            out[h] = fn(h)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(h,)) for h in range(hosts)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    if errs:
+        raise errs[0]
+    return out
+
+
+def _stacks(bus, layers=None, chain_fn=_lossless_chain):
+    return [TransportStack(wire=BusWire(bus, h),
+                           layers=list(layers) if layers else None,
+                           chain=chain_fn() if chain_fn else None)
+            for h in range(bus.hosts)]
+
+
+# ---------------------------------------------------------------------------
+# SimBus exchanges vs the numpy oracle (the pre-refactor BSP semantics)
+# ---------------------------------------------------------------------------
+
+def test_simbus_allreduce_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    contribs = [rng.standard_normal(257).astype(np.float32)
+                for _ in range(3)]
+    oracle = np.sum(np.stack(contribs), axis=0)
+    bus = SimBus(3)
+    stacks = _stacks(bus)
+    got = _run_hosts(3, lambda h: stacks[h].allreduce(
+        contribs[h], None, op="sum", site="t/red"))
+    for g in got:
+        # lossless chain: the summed array is bit-identical everywhere
+        assert np.array_equal(np.asarray(g), oracle)
+
+
+def test_simbus_allreduce_unfiltered_matches_filtered():
+    """The raw-wire path (no chain) and the lossless-chain path reduce
+    to the same bits: the codec is transparent."""
+    rng = np.random.default_rng(1)
+    # float32: the raw path reduces through jnp, which would downcast
+    # float64 inputs (x64 off) and break bitwise comparison
+    contribs = [rng.standard_normal(64).astype(np.float32)
+                for _ in range(2)]
+
+    def reduce_with(chain_fn):
+        bus = SimBus(2)
+        stacks = _stacks(bus, chain_fn=chain_fn)
+        return _run_hosts(2, lambda h: stacks[h].allreduce(
+            contribs[h], None, op="sum", site="t/red"))
+
+    raw = reduce_with(None)
+    coded = reduce_with(_lossless_chain)
+    assert np.array_equal(np.asarray(raw[0]), np.asarray(coded[0]))
+    assert np.array_equal(np.asarray(raw[0]), np.asarray(raw[1]))
+
+
+def test_simbus_allgather_and_broadcast():
+    bus = SimBus(2)
+    stacks = _stacks(bus)
+
+    def body(h):
+        g = stacks[h].allgather(np.full(5, float(h)), None, site="t/g")
+        b = stacks[h].broadcast(
+            {"v": np.arange(4.0) + h}, None, root=1, site="t/b")
+        stacks[h].sync("fence")
+        return g, b
+
+    got = _run_hosts(2, body)
+    for g, b in got:
+        assert np.array_equal(np.asarray(g),
+                              np.stack([np.full(5, 0.0), np.full(5, 1.0)]))
+        assert np.array_equal(np.asarray(b["v"]), np.arange(4.0) + 1)
+
+
+def test_simbus_min_and_max_ops():
+    bus = SimBus(2)
+    stacks = _stacks(bus, chain_fn=None)
+    vals = [np.asarray([3.0, -1.0]), np.asarray([2.0, 5.0])]
+    got = _run_hosts(2, lambda h: (
+        stacks[h].allreduce(vals[h], None, op="max", site="t/mx"),
+        stacks[h].allreduce(vals[h], None, op="min", site="t/mn")))
+    for mx, mn in got:
+        assert np.array_equal(np.asarray(mx), [3.0, 5.0])
+        assert np.array_equal(np.asarray(mn), [2.0, -1.0])
+
+
+# ---------------------------------------------------------------------------
+# single-process fast path == the pre-refactor BSP oracle, per path
+# ---------------------------------------------------------------------------
+
+def test_single_process_paths_bit_parity():
+    """On one process the pre-refactor BSP collectives returned the
+    tree itself (allreduce), a leading axis (allgather), and the root
+    tree (broadcast). The LocalLayer fast path must keep those bits —
+    for the direct path AND for the same call routed through an
+    ExchangeEngine drain thread at tau=0."""
+    from wormhole_tpu.parallel.collectives import (allgather_tree,
+                                                   allreduce_tree,
+                                                   broadcast_tree)
+    x = np.random.default_rng(2).standard_normal(33).astype(np.float32)
+    direct = allreduce_tree(x, None, "sum", site="t/solo")
+    assert np.array_equal(np.asarray(direct), x)
+    g = allgather_tree({"a": x}, None, site="t/solo")
+    assert np.array_equal(np.asarray(g["a"]), x[None])
+    b = broadcast_tree(x, None, site="t/solo")
+    assert np.array_equal(np.asarray(b), x)
+
+    eng = ExchangeEngine(0)
+    try:
+        # transport: engine — parity probe routed via the drain thread
+        eng.submit(lambda: allreduce_tree(x, None, "sum", site="t/solo"))
+        (t,) = eng.gate()
+        assert np.array_equal(np.asarray(t.result), np.asarray(direct))
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# layer ordering: requires-constraints enforced, commuting suffix free
+# ---------------------------------------------------------------------------
+
+def test_validate_layers_rejects_required_order_violations():
+    with pytest.raises(ValueError, match="requires"):
+        validate_layers([SpanLayer(), SeqLayer()])
+    with pytest.raises(ValueError, match="requires"):
+        validate_layers([SeqLayer(), SpanLayer(), WatchdogLayer(),
+                         LocalLayer()])
+    with pytest.raises(ValueError, match="requires"):
+        validate_layers([SeqLayer(), SpanLayer(), LocalLayer(),
+                         AccountingLayer(), FilterLayer()])
+    # the canonical order always validates
+    validate_layers(default_layers())
+
+
+def test_commuting_layers_permute_without_changing_results():
+    """chaos/watchdog commute with each other and with the filter pair;
+    every legal permutation produces bit-identical reductions."""
+    rng = np.random.default_rng(3)
+    contribs = [rng.standard_normal(128).astype(np.float32)
+                for _ in range(2)]
+    orders = [
+        [SeqLayer(), SpanLayer(), LocalLayer(), ChaosLayer(),
+         WatchdogLayer(), FilterLayer(), AccountingLayer()],
+        [SeqLayer(), SpanLayer(), LocalLayer(), WatchdogLayer(),
+         ChaosLayer(), FilterLayer(), AccountingLayer()],
+        [SeqLayer(), SpanLayer(), LocalLayer(), FilterLayer(),
+         AccountingLayer(), ChaosLayer(), WatchdogLayer()],
+    ]
+    results = []
+    for layers in orders:
+        bus = SimBus(2)
+        stacks = _stacks(bus, layers=layers)
+        got = _run_hosts(2, lambda h: stacks[h].allreduce(
+            contribs[h], None, op="sum", site="t/perm"))
+        results.append(np.asarray(got[0]))
+    for r in results[1:]:
+        assert np.array_equal(results[0], r)
+
+
+def test_seq_counter_shared_across_paths():
+    """One counter space per site: host exchanges and mesh dispatches
+    at the same site interleave their seq numbers (obs/merge matches
+    spans across ranks by (site, seq))."""
+    from wormhole_tpu.parallel.collectives import allreduce_tree
+    allreduce_tree(np.asarray(1.0), None, "sum", site="t/seq")
+    allreduce_tree(np.asarray(1.0), None, "sum", site="t/seq")
+    MeshTransport(site="t/seq").dispatch(lambda: None)
+    assert transport._SITE_SEQ["t/seq"] == 3
+
+
+# ---------------------------------------------------------------------------
+# watchdog arming: one harness, every path
+# ---------------------------------------------------------------------------
+
+def _armed_sites(run):
+    """Shared harness: install a real watchdog with a recording ``arm``,
+    run the path, return every site that armed (any thread)."""
+    w = watchdog.configure(60.0, exit_fn=lambda s: None)
+    seen, orig = [], w.arm
+
+    def arm(site):
+        seen.append(site)
+        orig(site)
+
+    w.arm = arm
+    try:
+        run()
+    finally:
+        watchdog.shutdown()
+    return seen
+
+
+def test_watchdog_arms_on_every_path():
+    x = np.ones(8, np.float32)
+
+    # path 1: the direct stack exchange (BSP tree collectives)
+    def direct():
+        bus = SimBus(2)
+        stacks = _stacks(bus)
+        _run_hosts(2, lambda h: stacks[h].allreduce(
+            x, None, op="sum", site="t/wd-direct"))
+
+    assert "t/wd-direct" in _armed_sites(direct)
+
+    # path 2: the same exchange routed through the engine drain thread
+    def engined():
+        bus = SimBus(2)
+        stacks = _stacks(bus)
+
+        def host(h):
+            eng = ExchangeEngine(0)
+            try:
+                # transport: engine — arming probe on the drain thread
+                eng.submit(lambda: stacks[h].allreduce(
+                    x, None, op="sum", site="t/wd-engine"))
+                eng.gate()
+            finally:
+                eng.stop()
+
+        _run_hosts(2, host)
+
+    assert "t/wd-engine" in _armed_sites(engined)
+
+    # path 3: the mesh dispatch (shard_map leg)
+    assert "t/wd-mesh" in _armed_sites(
+        lambda: MeshTransport(site="t/wd-mesh").dispatch(lambda: None))
+
+    # path 4: the named barrier
+    def fence():
+        bus = SimBus(2)
+        stacks = _stacks(bus)
+        _run_hosts(2, lambda h: stacks[h].sync("ckpt"))
+
+    assert "sync:ckpt" in _armed_sites(fence)
+
+
+# ---------------------------------------------------------------------------
+# accounting: wire bytes booked per exchange, raw > wire under zlib
+# ---------------------------------------------------------------------------
+
+def test_accounting_books_bytes_onto_exchange_attrs():
+    bus = SimBus(2)
+    stacks = _stacks(bus)
+    exs = [Exchange("allreduce", np.zeros(4096, np.float32), op="sum",
+                    site="t/acct") for _ in range(2)]
+    _run_hosts(2, lambda h: stacks[h].execute(exs[h]))
+    for h, ex in enumerate(exs):
+        assert ex.attrs["site"] == "t/acct"
+        assert ex.attrs["seq"] in (0, 1)
+        assert ex.attrs["bytes_raw"] >= 4096 * 4
+        # zeros compress: measured wire bytes exist and are smaller
+        assert 0 < ex.attrs["bytes_wire"] < ex.attrs["bytes_raw"]
+        assert stacks[h].chain.stats["bytes_wire"] > 0
+
+
+def test_ici_ring_bytes_model():
+    assert ici_ring_bytes(1000, 1) == 0
+    assert ici_ring_bytes(1000, 2) == 1000      # 2(k-1)/k == 1
+    assert ici_ring_bytes(1000, 4) == 1500      # 2·3/4 == 1.5
+    assert ici_ring_bytes(0, 8) == 0
+
+
+# ---------------------------------------------------------------------------
+# the tentpole parity: tau=0 hierarchy bit-identical to direct BSP
+# ---------------------------------------------------------------------------
+
+def _run_hierarchy(hosts, windows, slots0, use_engine):
+    """One 2D run: per-host jitted local step then the cross-host delta
+    reduce, either inline (direct BSP) or through an ExchangeEngine at
+    tau=0. Returns the per-host final slot arrays."""
+    bus = SimBus(hosts)
+    local_step = jax.jit(lambda s, k: jax.numpy.tanh(s * 0.1 + k))
+
+    def host(h):
+        slots = slots0.copy()
+        stack = TransportStack(wire=BusWire(bus, h),
+                               chain=_lossless_chain())
+        hier = HierarchicalTransport(
+            MeshTransport(site=f"t/mesh{h}"), stack,
+            engine=ExchangeEngine(0) if use_engine else None,
+            site="t/hier")
+        try:
+            for w in range(windows):
+                local = hier.local_dispatch(
+                    local_step, slots, float(h + w), ici_bytes=0)
+                t = hier.submit_delta(np.asarray(local))
+                for done in ([t] if not use_engine else hier.gate()):
+                    slots = slots + np.asarray(done.result)
+            for done in hier.quiesce():
+                slots = slots + np.asarray(done.result)
+        finally:
+            hier.stop()
+        return slots
+
+    return _run_hosts(hosts, host)
+
+
+def test_hierarchy_tau0_engine_bit_identical_to_direct_bsp():
+    """The acceptance oracle: at tau=0 the engine-routed hierarchy is
+    submit-then-wait and must produce bit-identical slots to the direct
+    (engine-less) BSP exchange — per host, slot level."""
+    slots0 = np.random.default_rng(4).standard_normal(96)
+    direct = _run_hierarchy(2, windows=5, slots0=slots0,
+                            use_engine=False)
+    engined = _run_hierarchy(2, windows=5, slots0=slots0,
+                             use_engine=True)
+    # every host converged to the same slots, and the two routings agree
+    # bit for bit
+    for d, e in zip(direct, engined):
+        assert np.array_equal(d, e)
+    assert np.array_equal(direct[0], direct[1])
+    # and the run actually moved: the reduce summed real deltas
+    assert not np.array_equal(direct[0], slots0)
+
+
+def test_hierarchy_exchange_delta_matches_manual_sum():
+    """exchange_delta is a plain summed reduce over the filtered wire."""
+    rng = np.random.default_rng(5)
+    deltas = [rng.standard_normal(40).astype(np.float32)
+              for _ in range(2)]
+    bus = SimBus(2)
+
+    def host(h):
+        hier = HierarchicalTransport(
+            MeshTransport(), TransportStack(wire=BusWire(bus, h),
+                                            chain=_lossless_chain()),
+            site="t/hier2")
+        assert hier.gate() == [] and hier.quiesce() == []
+        return hier.exchange_delta(deltas[h])
+
+    got = _run_hosts(2, host)
+    oracle = np.sum(np.stack(deltas), axis=0)
+    for g in got:
+        assert np.array_equal(np.asarray(g), oracle)

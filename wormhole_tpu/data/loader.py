@@ -79,6 +79,7 @@ def load_dense_batches(uri: str, rt: MeshRuntime, *,
                                 minibatch_size))
     local_max = max((b.max_index() for b in blocks), default=0)
     if not num_features:
+        # transport: direct — startup feature-count agreement, before any engine exists
         num_features = int(allreduce_tree(np.int64(local_max + 1),
                                           rt.mesh, "max",
                                           site="loader/num_features"))

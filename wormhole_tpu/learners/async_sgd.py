@@ -1349,7 +1349,7 @@ class AsyncSGD:
                 self._rejoin_vv.bump(self.rt.rank)
                 payload["vv"] = self._rejoin_vv.one_hot(self.rt.rank)
             engine.submit(
-                # ps-engine: the closure executes on the drain thread
+                # transport: engine — the closure executes on the drain thread
                 lambda p=payload: allreduce_tree(
                     p, self.rt.mesh, "sum", site="ps/delta"))
             with self.timer.scope("wait"):
@@ -1416,7 +1416,7 @@ class AsyncSGD:
             # one exchange per global step:
             # (finished part, need, drained, blocks contributed)
             status = self._ctl(
-                # ps-engine: control exchange on the drain thread
+                # transport: engine — control exchange on the drain thread
                 lambda: allgather_tree(
                     rr.status_row(finished_id, need, drained),
                     self.rt.mesh, site="async_sgd/status"))
@@ -1465,7 +1465,7 @@ class AsyncSGD:
                     else:
                         rr.produced(1)
             have = int(self._ctl(
-                # ps-engine: control exchange on the drain thread
+                # transport: engine — control exchange on the drain thread
                 lambda b=blk: allreduce_tree(np.int64(b is not None),
                                              self.rt.mesh, "sum",
                                              site="async_sgd/have")))
@@ -1612,7 +1612,7 @@ class AsyncSGD:
             # claimant (drained flips back off when the pool hands work)
             need = my_it is None
             status = self._ctl(
-                # ps-engine: control exchange on the drain thread
+                # transport: engine — control exchange on the drain thread
                 lambda: allgather_tree(
                     rr.status_row(finished_id, need, drained),
                     self.rt.mesh, site="async_sgd/status"))
@@ -1648,7 +1648,7 @@ class AsyncSGD:
                     my_it = feed_iter(my_wl, my_skip)
                     collect(group)   # contribute in the claim round too
             have = int(self._ctl(
-                # ps-engine: control exchange on the drain thread
+                # transport: engine — control exchange on the drain thread
                 lambda g=group: allreduce_tree(np.int64(len(g)),
                                                self.rt.mesh, "sum",
                                                site="async_sgd/have")))
@@ -1751,7 +1751,7 @@ class AsyncSGD:
             # ranks must agree on the resume point even when the
             # checkpoint dir is not shared: the slowest view wins
             ver = int(self._ctl(
-                # ps-engine: control exchange on the drain thread
+                # transport: engine — control exchange on the drain thread
                 lambda: allreduce_tree(np.int64(ckpt.latest_version()),
                                        self.rt.mesh, "min",
                                        site="async_sgd/ckpt_ver")))
@@ -1877,7 +1877,7 @@ class AsyncSGD:
         # one tree, one exchange — and each leaf keeps its own
         # error-feedback residual slot at the site
         pos, neg = self._ctl(
-            # ps-engine: control exchange on the drain thread
+            # transport: engine — control exchange on the drain thread
             lambda: allreduce_tree((pos, neg), self.rt.mesh, "sum",
                                    compress=z, site="async_sgd/auc_hist"))
         return auc_from_hist(np.asarray(pos), np.asarray(neg))

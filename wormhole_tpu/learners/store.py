@@ -205,6 +205,30 @@ def mesh_step_specs(have_model):
     return Pm, Pblk, data_specs
 
 
+def mesh_step_ici_bytes(rt: "MeshRuntime", *, margin_elems: int,
+                        grad_elems: int = 0, extra_data_elems: int = 0,
+                        train: bool = True) -> int:
+    """Modeled ICI bytes ONE device moves for a mesh step dispatch —
+    the single declaration site of the model (transport's MeshTransport
+    books the result into ``comm/bytes_ici``). Every mesh step shares
+    the same collective skeleton: margins/pulls psum over MODEL, the
+    packed metric row psum over DATA, and (train only) grad/push psum
+    over DATA plus the wdelta2 scalar over MODEL. ``extra_data_elems``
+    covers model-specific data-axis payloads (wide&deep's MLP grads).
+    Each psum is costed at the ring-allreduce 2(k-1)/k·n bound; a
+    trivial axis costs zero (XLA elides the collective)."""
+    from wormhole_tpu.parallel.transport import ici_ring_bytes
+    m = rt.model_axis_size if rt.have_model else 1
+    d = rt.data_axis_size
+    n = ici_ring_bytes(4 * int(margin_elems), m)
+    n += ici_ring_bytes(4 * (TableCheckpoint.MACC_LEN - 1), d)
+    if train:
+        n += ici_ring_bytes(4 * (int(grad_elems) + int(extra_data_elems)),
+                            d)
+        n += ici_ring_bytes(4, m)
+    return n
+
+
 def mesh_group_shardings(rt: MeshRuntime, is_tile: bool):
     """NamedSharding pytree for ONE stacked D-group, matching the mesh
     steps' in_specs exactly — the layout the sharded feed
@@ -326,6 +350,17 @@ class TableCheckpoint:
             theta = getattr(self.cfg, "lr_theta", 1.0)
             v = cache[tau] = jnp.asarray(tau * theta, jnp.float32)
         return v
+
+    def _mesh_transport(self):
+        """The shared intra-host transport leg every mesh dispatcher
+        routes through (parallel/transport.MeshTransport): site/seq
+        stamping, the collective:mesh span, chaos/watchdog, and
+        comm/bytes_ici accounting around the compiled step."""
+        tx = getattr(self, "_mesh_tx", None)
+        if tx is None:
+            from wormhole_tpu.parallel.transport import MeshTransport
+            tx = self._mesh_tx = MeshTransport(site="mesh/step")
+        return tx
 
 
 class ShardedStore(TableCheckpoint):
@@ -620,16 +655,22 @@ class ShardedStore(TableCheckpoint):
         stacked on a leading axis. Metrics accumulate on device
         (fetch_metrics); returns the step-clock scalar."""
         step = self._dense_step_mesh(block_rows, nnz, "train")
-        self.slots, t_new, self._macc = step(
-            self.slots, packed, self._t_device(), self._tau_const(tau),
-            self._macc_buf())
+        nb_local = self.cfg.num_buckets // max(self.rt.model_axis_size, 1)
+        self.slots, t_new, self._macc = self._mesh_transport().dispatch(
+            step, self.slots, packed, self._t_device(),
+            self._tau_const(tau), self._macc_buf(),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=block_rows, grad_elems=nb_local))
         self._advance_t(t_new)
         return t_new
 
     def dense_eval_step_mesh(self, packed: jax.Array, block_rows: int,
                              nnz: int):
-        return self._dense_step_mesh(block_rows, nnz, "eval")(
-            self.slots, packed)
+        return self._mesh_transport().dispatch(
+            self._dense_step_mesh(block_rows, nnz, "eval"),
+            self.slots, packed,
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=block_rows, train=False))
 
     # -- tile-blocked MXU step: the crec2 streaming fast path ---------------
     #
@@ -874,10 +915,14 @@ class ShardedStore(TableCheckpoint):
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
         z = mesh_ovf_zeros(D, oc)
-        self.slots, t_new, self._macc = step(
-            self.slots, blocks["pw"], blocks["labels"],
+        nb_local = mesh_tile_geometry(self.rt, info.spec)[0]
+        self.slots, t_new, self._macc = self._mesh_transport().dispatch(
+            step, self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
-            self._t_device(), self._tau_const(tau), self._macc_buf())
+            self._t_device(), self._tau_const(tau), self._macc_buf(),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows,
+                grad_elems=nb_local))
         self._advance_t(t_new)
         return t_new
 
@@ -885,9 +930,12 @@ class ShardedStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         z = mesh_ovf_zeros(D, oc)
-        return self._tile_step_mesh(info, "eval")(
+        return self._mesh_transport().dispatch(
+            self._tile_step_mesh(info, "eval"),
             self.slots, blocks["pw"], blocks["labels"],
-            blocks.get("ovf_b", z), blocks.get("ovf_r", z))
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows, train=False))
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block step over a typed block dict (crec.block2_views

@@ -30,6 +30,8 @@ import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.learners.store import (TableCheckpoint,
                                           mesh_ovf_zeros,
+                                          mesh_step_ici_bytes,
+                                          mesh_tile_geometry,
                                           shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
@@ -423,10 +425,16 @@ class FMStore(TableCheckpoint):
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
         z = mesh_ovf_zeros(D, oc)
-        self.slots, t_new, self._macc = step(
-            self.slots, blocks["pw"], blocks["labels"],
+        # pull/push channels: w, v[dim], sum(v*v) / dual row-mask ticket
+        ch = self.cfg.dim + 2
+        nb_local = mesh_tile_geometry(self.rt, info.spec)[0]
+        self.slots, t_new, self._macc = self._mesh_transport().dispatch(
+            step, self.slots, blocks["pw"], blocks["labels"],
             blocks.get("ovf_b", z), blocks.get("ovf_r", z),
-            self._t_device(), self._tau_const(tau), self._macc_buf())
+            self._t_device(), self._tau_const(tau), self._macc_buf(),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows * ch,
+                grad_elems=nb_local * ch))
         self._advance_t(t_new)
         return t_new
 
@@ -434,9 +442,14 @@ class FMStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         z = mesh_ovf_zeros(D, oc)
-        return self._tile_step_mesh(info, "eval")(
+        ch = self.cfg.dim + 2
+        return self._mesh_transport().dispatch(
+            self._tile_step_mesh(info, "eval"),
             self.slots, blocks["pw"], blocks["labels"],
-            blocks.get("ovf_b", z), blocks.get("ovf_r", z))
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows * ch,
+                train=False))
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block FM step; metrics accumulate ON DEVICE

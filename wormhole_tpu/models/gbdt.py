@@ -349,12 +349,14 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
     from wormhole_tpu.parallel.collectives import (allgather_tree,
                                                    allreduce_tree)
     ids_local = np.unique(ef_orig)
+    # transport: direct — BSP tree pass, no engine live
     n_max = int(allreduce_tree(np.int64(len(ids_local)), runtime.mesh,
                                "max", site="gbdt/sketch_size"))
     if n_max == 0:
         raise FileNotFoundError("no entries on any host")
     buf = np.full(n_max, -1, np.int64)
     buf[:len(ids_local)] = ids_local
+    # transport: direct — BSP tree pass, no engine live
     gathered = np.asarray(allgather_tree(buf, runtime.mesh,
                                          site="gbdt/sketch")).ravel()
     feat_ids = np.unique(gathered[gathered >= 0])
@@ -373,12 +375,14 @@ def _global_sparse_sketch(ef_orig: np.ndarray, ev: np.ndarray,
                                        take).astype(np.int64)])
     else:
         sel = np.zeros(0, np.int64)
+    # transport: direct — BSP tree pass, no engine live
     cap_max = int(allreduce_tree(np.int64(take), runtime.mesh, "max",
                                  site="gbdt/sketch_size"))
     ef_buf = np.full(cap_max, -1, np.int64)
     ev_buf = np.zeros(cap_max, np.float32)
     ef_buf[:take] = ef_orig[sel]
     ev_buf[:take] = ev[sel]
+    # transport: direct — BSP tree pass, no engine live
     ef_m, ev_m = (np.asarray(a).ravel() for a in allgather_tree(
         (ef_buf, ev_buf), runtime.mesh, site="gbdt/sketch"))
     keep = ef_m >= 0
@@ -667,6 +671,7 @@ class GBDT:
                 # "gbdt/level_hist" is lossy-allowed: split decisions
                 # compare reduced sums identically on every host, and
                 # the error-feedback residual carries across levels
+                # transport: direct — BSP tree pass, no engine live
                 gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
                                         compress=cfg.msg_compression,
                                         site="gbdt/level_hist")
@@ -689,6 +694,7 @@ class GBDT:
                         num_nodes=level_nodes // 2, num_bins=cfg.num_bins,
                         kernel=cfg.gbdt_hist_kernel)
                     gl, hl = np.asarray(gl), np.asarray(hl)
+                # transport: direct — BSP tree pass, no engine live
                 gl, hl = allreduce_tree((gl, hl), self.rt.mesh,
                                         compress=cfg.msg_compression,
                                         site="gbdt/level_hist")
@@ -742,10 +748,12 @@ class GBDT:
                                                        allreduce_tree)
         cap = 1 << 16
         take = np.asarray(x[:cap], np.float32)
+        # transport: direct — BSP tree pass, no engine live
         n_max = int(allreduce_tree(np.int64(len(take)), self.rt.mesh,
                                    "max", site="gbdt/sketch_size"))
         buf = np.full((n_max, x.shape[1]), np.nan, np.float32)
         buf[:len(take)] = take
+        # transport: direct — BSP tree pass, no engine live
         merged = np.asarray(allgather_tree(buf, self.rt.mesh,
                                            site="gbdt/sketch")
                             ).reshape(-1, x.shape[1])
@@ -810,6 +818,7 @@ class GBDT:
             else:
                 num_l = float(jnp.sum((margin - labels) ** 2 * mask))
             from wormhole_tpu.parallel.collectives import allreduce_tree
+            # transport: direct — BSP tree pass, no engine live
             num, den = allreduce_tree(
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
                 site="gbdt/eval")
@@ -884,6 +893,7 @@ class GBDT:
             raise FileNotFoundError(f"no rows in {uri}")
         labels_np = np.concatenate(labels_parts).astype(np.float32)
         if jax.process_count() > 1 and not num_features:
+            # transport: direct — BSP tree pass, no engine live
             F = int(allreduce_tree(np.int64(F), self.rt.mesh, "max",
                                    site="gbdt/num_features"))
         start_round = self._load_checkpoint(F)
@@ -959,6 +969,7 @@ class GBDT:
                         num_l += float(jnp.sum((m - lab) ** 2 * mk))
             finally:
                 self._drain_chunk_stats(feed)
+            # transport: direct — BSP tree pass, no engine live
             num, den = allreduce_tree(
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
                 site="gbdt/eval")
@@ -1023,6 +1034,7 @@ class GBDT:
                     hh = hc if hh is None else hh + hc
             finally:
                 self._drain_chunk_stats(feed)
+            # transport: direct — BSP tree pass, no engine live
             gh, hh = allreduce_tree((gh, hh), self.rt.mesh,
                                     compress=cfg.msg_compression,
                                     site="gbdt/level_hist")
@@ -1103,6 +1115,7 @@ class GBDT:
                     num_feat=num_feat, kernel=cfg.gbdt_hist_kernel)
                 gl, hl, gtl, htl = (np.asarray(a)
                                     for a in (gl, hl, gtl, htl))
+            # transport: direct — BSP tree pass, no engine live
             gl, hl, gtl, htl = allreduce_tree(
                 (gl, hl, gtl, htl), self.rt.mesh,
                 compress=cfg.msg_compression, site="gbdt/level_hist")
@@ -1192,6 +1205,7 @@ class GBDT:
                 num_l = float(logloss(labels, margin, mask)) * den_l
             else:
                 num_l = float(jnp.sum((margin - labels) ** 2 * mask))
+            # transport: direct — BSP tree pass, no engine live
             num, den = allreduce_tree(
                 (np.float64(num_l), np.float64(den_l)), self.rt.mesh,
                 site="gbdt/eval")
@@ -1263,6 +1277,7 @@ class GBDT:
                 "acc": float(accuracy(labels, m, mask)) * n_l,
                 "ll": float(logloss(labels, m, mask)) * n_l}
         pos, neg = margin_hist(labels, m, mask)
+        # transport: direct — BSP tree pass, no engine live
         red = allreduce_tree(
             {**{k: np.float64(v) for k, v in sums.items()},
              "pos": np.asarray(pos), "neg": np.asarray(neg)},
@@ -1292,6 +1307,7 @@ class GBDT:
             # the _global_cuts collectives run) even when the checkpoint
             # dir is not shared: the slowest view wins
             from wormhole_tpu.parallel.collectives import allreduce_tree
+            # transport: direct — BSP tree pass, no engine live
             ver = int(allreduce_tree(np.int64(ver), self.rt.mesh, "min",
                                      site="gbdt/ckpt_ver"))
         if not ver:
@@ -1547,6 +1563,7 @@ def main(argv=None) -> int:
         if rt.world > 1 and not cli.num_features:
             # hosts must agree on the column count (the reference's
             # rabit::Allreduce<op::Max>, lbfgs-linear/linear.cc:110)
+            # transport: direct — BSP tree pass, no engine live
             F = int(allreduce_tree(np.int64(x.shape[1]), rt.mesh, "max",
                                    site="gbdt/num_features"))
             if x.shape[1] < F:

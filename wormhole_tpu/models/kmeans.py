@@ -202,6 +202,7 @@ class KMeans:
         if n_have < k:
             cent[n_have:] = rng.standard_normal((k - n_have, f)) * 0.01
         from wormhole_tpu.parallel.collectives import broadcast_tree
+        # transport: direct — BSP Lloyd iteration, no engine live
         cent = broadcast_tree(cent, self.rt.mesh, root=0,
                               site="kmeans/init_centroids")
         state = KMeansState(
@@ -232,6 +233,7 @@ class KMeans:
             # quantize, with error feedback carrying across iterations
             sums, counts, objv, seen = jax.tree.map(
                 jnp.asarray,
+                # transport: direct — BSP Lloyd iteration, no engine live
                 allreduce_tree(jax.tree.map(np.asarray, stats),
                                self.rt.mesh, "sum", site="kmeans/stats"))
         new_state = _recompute(state, sums, counts)

@@ -94,6 +94,7 @@ class LinearObjective:
         descent direction, error-fed); the line-search and convergence
         objectives reduce at exact sites."""
         if self.rt is not None and jax.process_count() > 1:
+            # transport: direct — BSP reduction helper, no engine live
             return allreduce_tree(jax.tree.map(np.asarray, tree),
                                   self.rt.mesh, "sum", site=site)
         return tree

@@ -31,6 +31,8 @@ import numpy as np
 from wormhole_tpu.data.feed import SparseBatch
 from wormhole_tpu.learners.store import (TableCheckpoint,
                                           mesh_ovf_zeros,
+                                          mesh_step_ici_bytes,
+                                          mesh_tile_geometry,
                                           shard_param_table)
 from wormhole_tpu.ops.loss import create_loss
 from wormhole_tpu.ops.metrics import accuracy, auc
@@ -431,13 +433,22 @@ class WideDeepStore(TableCheckpoint):
         D = self.rt.data_axis_size
         step = self._tile_step_mesh(info, "train")
         z = mesh_ovf_zeros(D, oc)
+        # pull channels: w + pooled[dim]; push adds the row-mask ticket;
+        # replicated MLP grads psum over data as an extra payload
+        ch = self.cfg.dim + 1
+        nb_local = mesh_tile_geometry(self.rt, info.spec)[0]
+        mlp_elems = sum(int(np.asarray(p).size)
+                        for p in jax.tree.leaves(self.mlp))
         (self.slots, self.mlp, self.mlp_accum, t_new,
-         self._macc) = step(self.slots, self.mlp, self.mlp_accum,
-                            blocks["pw"], blocks["labels"],
-                            blocks.get("ovf_b", z),
-                            blocks.get("ovf_r", z),
-                            self._t_device(), self._tau_const(tau),
-                            self._macc_buf())
+         self._macc) = self._mesh_transport().dispatch(
+            step, self.slots, self.mlp, self.mlp_accum,
+            blocks["pw"], blocks["labels"],
+            blocks.get("ovf_b", z), blocks.get("ovf_r", z),
+            self._t_device(), self._tau_const(tau), self._macc_buf(),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows * ch,
+                grad_elems=nb_local * (ch + 1),
+                extra_data_elems=mlp_elems))
         self._advance_t(t_new)
         return t_new
 
@@ -445,10 +456,15 @@ class WideDeepStore(TableCheckpoint):
         oc = info.ovf_cap
         D = self.rt.data_axis_size
         z = mesh_ovf_zeros(D, oc)
-        return self._tile_step_mesh(info, "eval")(
+        ch = self.cfg.dim + 1
+        return self._mesh_transport().dispatch(
+            self._tile_step_mesh(info, "eval"),
             self.slots, self.mlp, self.mlp_accum, blocks["pw"],
             blocks["labels"], blocks.get("ovf_b", z),
-            blocks.get("ovf_r", z))
+            blocks.get("ovf_r", z),
+            ici_bytes=mesh_step_ici_bytes(
+                self.rt, margin_elems=info.block_rows * ch,
+                train=False))
 
     def tile_train_step(self, block: dict, info, tau: float = 0.0):
         """Fused crec2-block wide&deep step; metrics accumulate ON DEVICE

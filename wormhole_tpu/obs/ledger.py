@@ -74,6 +74,10 @@ SPAN_TABLE: Dict[str, str] = {
     # are device work; the sync-mode hot-loop group stack is host prep
     # (the ring mode moves it into the feed's ``stack`` stage below)
     "mesh:dispatch": "device_compute",
+    # transport-wrapped mesh dispatch (MeshTransport.dispatch); same
+    # bucket as mesh:dispatch so routing through the transport layer
+    # does not shift ledger attribution
+    "collective:mesh": "device_compute",
     "mesh:spill": "device_compute",
     "mesh:stack": "host_prep",
     "stack": "host_prep",

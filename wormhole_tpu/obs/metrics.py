@@ -266,11 +266,16 @@ class Registry:
              if getattr(self._metrics[n], "agg", "") == "min" else np.inf
              for n in scalars], np.float64)
         # site "obs/registry" is NOT in the lossy allowlist: metric
-        # counters merge bit-exact (docs/comm.md's exact-semantics rule)
+        # counters merge bit-exact (docs/comm.md's exact-semantics rule).
+        # All registry merges are `transport: direct`: metrics windows
+        # run with the engine quiesced (collective:metrics_window).
+        # transport: direct — engine quiesced around the window
         sums = np.asarray(allreduce_tree(sums, mesh, "sum",
                                          site="obs/registry"))
+        # transport: direct — engine quiesced around the window
         maxs = np.asarray(allreduce_tree(maxs, mesh, "max",
                                          site="obs/registry"))
+        # transport: direct — engine quiesced around the window
         mins = np.asarray(allreduce_tree(mins, mesh, "min",
                                          site="obs/registry"))
         for i, n in enumerate(scalars):
@@ -286,10 +291,12 @@ class Registry:
             if m.kind != "histogram":
                 continue
             vec = np.array(m.bins + [m.count], np.float64)
+            # transport: direct — engine quiesced around the window
             vec = np.asarray(allreduce_tree(vec, mesh, "sum",
                                             site="obs/registry"))
             m.bins = [int(v) for v in vec[:-1]]
             m.count = int(vec[-1])
+            # transport: direct — engine quiesced around the window
             m.sum = float(np.asarray(
                 allreduce_tree(np.float64(m.sum), mesh, "sum",
                                site="obs/registry")))

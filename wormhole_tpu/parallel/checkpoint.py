@@ -252,12 +252,12 @@ class ShardCheckpointer:
             _commit_bytes(self._rank_path(version, self.rank), data)
             if barrier:
                 # all ranks must have committed before the version
-                # becomes valid
-                from jax.experimental import multihost_utils
+                # becomes valid; the barrier rides the transport stack
+                # (watchdog-armed there, under the same site string)
+                from wormhole_tpu.parallel import transport
                 with trace.span("collective:ckpt_barrier", cat="collective"):
-                    with _watchdog.guard("ckpt_barrier"):
-                        multihost_utils.sync_global_devices(
-                            f"ckpt_v{version}")
+                    transport.default_stack().sync(
+                        f"ckpt_v{version}", site="ckpt_barrier")
             # the marker is a commit record too: durable + atomic, so a
             # crash between barrier and marker never leaves a marker
             # pointing at unsynced bytes

@@ -6,10 +6,19 @@ equivalents split by where they run:
 - **inside jit** (the hot path): ``psum/pmax/pmin`` over mesh axis names —
   use ``psum_tree`` etc. from inside ``shard_map``/pjit-compiled steps. XLA
   lowers these onto ICI rings; nothing to implement.
-- **host level** (setup, metrics, model broadcast): thin wrappers that jit a
-  collective over the live mesh. On one host with one mesh these reduce over
-  the *device* axis; across hosts JAX's multi-controller runtime makes the
-  same program global (each process provides its addressable shards).
+- **host level** (setup, metrics, model broadcast): thin wrappers over the
+  unified transport stack (parallel/transport.py). On one host with one
+  mesh these reduce over the *device* axis; across hosts JAX's
+  multi-controller runtime makes the same program global (each process
+  provides its addressable shards).
+
+Since the transport refactor these wrappers are the stable public
+surface only: site-id/seq stamping, spans, chaos, watchdog arming, the
+FilterChain codec and wire-byte accounting all live as composable
+layers in :mod:`wormhole_tpu.parallel.transport`, folded identically
+under every exchange path (these BSP wrappers, the ps engine's drain
+thread, and the mesh leg). Raw multi-controller calls exist only in
+transport.ProcessWire (scripts/lint_collectives.py rule 1).
 
 rabit's lazy-prepare Allreduce (``Allreduce(ptr, n, prepare_fn)``,
 kmeans.cc:249) deliberately has NO class here: its purpose is letting a
@@ -23,16 +32,15 @@ as the versioned Checkpointer (parallel/checkpoint.py).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from wormhole_tpu.ft import chaos as _chaos
-from wormhole_tpu.ft import watchdog as _watchdog
-from wormhole_tpu.obs import trace
+from wormhole_tpu.parallel import transport as _transport
+from wormhole_tpu.parallel.transport import reset_site_seq  # noqa: F401
+# re-exported: tests and fresh logical runs reset the per-site seq
+# counters through this module, their historical home
 
 # ---------------------------------------------------------------------------
 # in-jit collectives (use inside shard_map'ed/pjit'ed code)
@@ -54,73 +62,12 @@ def pmin_tree(tree: Any, axis: str) -> Any:
 #
 # Every DCN hop below consults the process-global FilterChain
 # (parallel/filters.py — ps-lite's KEY_CACHING / FIXING_FLOAT /
-# COMPRESSING ported to pytrees). With no chain installed (the default)
-# the original unfiltered transport runs untouched. ``site`` is the
-# filter-chain contract: a stable, per-call-site string identical on
-# every host (see docs/comm.md) — it keys the key cache and the
-# error-feedback residuals, and labels the wire-byte accounting.
-
-def _resolve_chain(site, compress: bool):
-    """The chain this call should route through: the installed global
-    chain when active, else a compression-only fallback for legacy
-    ``compress=True`` callers (the pre-filters zlib leaf codec)."""
-    from wormhole_tpu.parallel import filters
-    chain = filters.get_chain()
-    if chain is not None and chain.active_for(site):
-        return chain
-    if compress:
-        global _LEGACY_Z
-        if _LEGACY_Z is None:
-            _LEGACY_Z = filters.FilterChain(filters={"compressing"},
-                                            min_bytes=0)
-        return _LEGACY_Z
-    return None
-
-
-_LEGACY_Z = None
-
-
-def _exchange_leaf(chain, site, idx, x, op):
-    """Ship one encoded leaf through a padded fixed-shape allgather and
-    decode every host's contribution. The gather pads each buffer to the
-    max wire length; decode slices back to the *sender's* true length
-    and the signature's dtype, so padding and dtype survive exactly
-    (f16, non-contiguous and int leaves included)."""
-    from jax.experimental import multihost_utils
-    buf = chain.encode_leaf(site, idx, x, op)
-    lens = np.asarray(multihost_utils.process_allgather(
-        np.int64(len(buf))))
-    pad = np.zeros(int(lens.max()), np.uint8)
-    pad[:len(buf)] = np.frombuffer(buf, np.uint8)
-    g = np.asarray(multihost_utils.process_allgather(pad))
-    return [chain.decode_leaf(site, idx, g[r, :int(lens[r])].tobytes())
-            for r in range(g.shape[0])]
-
-
-# per-site call counters stamped into collective span args: every rank
-# executes the same collective program, so the Nth call at a site is the
-# SAME logical collective on every rank — obs/merge.py matches spans
-# across rank trace files by (site, seq) to compute arrival skew. The
-# counter advances whether or not tracing is on (a late-enabled trace
-# must not desynchronize the numbering), and one counter covers all
-# collective kinds at a site (call order, not kind, is the identity).
-_SITE_SEQ: dict = {}
-
-
-def _stamp_seq(attrs) -> Optional[dict]:
-    if attrs is None:
-        return None
-    site = attrs["site"]
-    n = _SITE_SEQ.get(site, 0)
-    _SITE_SEQ[site] = n + 1
-    attrs["seq"] = n
-    return attrs
-
-
-def reset_site_seq() -> None:
-    """Forget per-site sequence numbers (tests / fresh logical runs)."""
-    _SITE_SEQ.clear()
-
+# COMPRESSING ported to pytrees) through the transport stack's
+# FilterLayer. With no chain installed (the default) the original
+# unfiltered transport runs untouched. ``site`` is the filter-chain
+# contract: a stable, per-call-site string identical on every host
+# (see docs/comm.md) — it keys the key cache and the error-feedback
+# residuals, and labels the wire-byte accounting.
 
 def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
                    compress: bool = False, site: str = None) -> Any:
@@ -132,71 +79,26 @@ def allreduce_tree(tree: Any, mesh: Mesh, op: str = "sum",
     semantics: the caller holds one logical copy, so no scaling happens.
 
     ``mesh`` is carried for API symmetry with the in-jit collectives and
-    future sharded transports; the host transport rides
-    ``process_allgather``, which spans all processes regardless of mesh
-    shape, so a None mesh (tests, ad-hoc tools) is accepted.
+    future sharded transports; the host transport rides the process-wide
+    wire, which spans all processes regardless of mesh shape, so a None
+    mesh (tests, ad-hoc tools) is accepted.
 
     ``compress`` (legacy knob, pre-dating the filter chain) routes the
     call through a compression-only chain; an installed FilterChain
     (filters.install_from_config) supersedes it and adds KEY_CACHING /
     FIXING_FLOAT per ``site``."""
-    # span recorded on the single-process fast path too: the boundary is
-    # where the sync would be, which is what a trace reader looks for
-    attrs = _stamp_seq({"site": site} if site else None)
-    with trace.span(f"collective:allreduce_{op}", cat="collective",
-                    args=attrs):
-        if jax.process_count() == 1:
-            return tree
-        from jax.experimental import multihost_utils
-        # multi-process branch only: the fast path above keeps the
-        # watchdog/chaos hooks entirely off the single-process cost
-        _chaos.on_collective(site)
-        with _watchdog.guard(site or f"allreduce_{op}"):
-            npfn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
-            fn = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
-            chain = _resolve_chain(site, compress)
-            if chain is not None:
-                leaves, treedef = jax.tree.flatten(tree)
-                raw0, wire0 = (chain.stats["bytes_raw"],
-                               chain.stats["bytes_wire"])
-                out = [npfn(np.stack(
-                           _exchange_leaf(chain, site, i, x, op)), axis=0)
-                       for i, x in enumerate(leaves)]
-                if attrs is not None:
-                    attrs["bytes_raw"] = chain.stats["bytes_raw"] - raw0
-                    attrs["bytes_wire"] = chain.stats["bytes_wire"] - wire0
-                return jax.tree.unflatten(treedef, out)
-
-            def reduce_leaf(x):
-                gathered = multihost_utils.process_allgather(jnp.asarray(x))
-                return np.asarray(fn(gathered, axis=0))
-
-            return jax.tree.map(reduce_leaf, tree)
+    return _transport.default_stack().allreduce(
+        tree, mesh, op=op, compress=compress, site=site)
 
 
 def allgather_tree(tree: Any, mesh: Mesh, site: str = None) -> Any:
     """Allgather a host-local pytree: every leaf gains a leading
-    process axis (rank order). The sanctioned route to
-    ``process_allgather`` — it rides the filter chain's lossless stages
+    process axis (rank order). The sanctioned route to the process
+    allgather — it rides the filter chain's lossless stages
     (KEY_CACHING + COMPRESSING; never FIXING_FLOAT: a gather is not a
     reduction, every rank's exact payload comes back) and books wire
     bytes like every other collective."""
-    with trace.span("collective:allgather", cat="collective",
-                    args=_stamp_seq({"site": site} if site else None)):
-        if jax.process_count() == 1:
-            return jax.tree.map(lambda x: np.asarray(x)[None], tree)
-        from jax.experimental import multihost_utils
-        _chaos.on_collective(site)
-        with _watchdog.guard(site or "allgather"):
-            chain = _resolve_chain(site, False)
-            if chain is not None:
-                leaves, treedef = jax.tree.flatten(tree)
-                out = [np.stack(_exchange_leaf(chain, site, i, x, "gather"))
-                       for i, x in enumerate(leaves)]
-                return jax.tree.unflatten(treedef, out)
-            return jax.tree.map(
-                lambda x: np.asarray(
-                    multihost_utils.process_allgather(jnp.asarray(x))), tree)
+    return _transport.default_stack().allgather(tree, mesh, site=site)
 
 
 def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
@@ -206,41 +108,15 @@ def broadcast_tree(tree: Any, mesh: Mesh, root: int = 0,
     With a filter chain installed the root's leaves ship encoded
     (lossless stages only) — one extra length broadcast per leaf buys
     compressed payloads on the DCN hop."""
-    with trace.span("collective:broadcast", cat="collective",
-                    args=_stamp_seq({"site": site} if site else None)):
-        if jax.process_count() == 1:
-            return tree
-        from jax.experimental import multihost_utils
-        _chaos.on_collective(site)
-        with _watchdog.guard(site or "broadcast"):
-            chain = _resolve_chain(site, False)
-            if chain is not None:
-                src = jax.process_index() == root
-                leaves, treedef = jax.tree.flatten(tree)
-                out = []
-                for i, x in enumerate(leaves):
-                    buf = (chain.encode_leaf(site, i, x, "bcast")
-                           if src else b"")
-                    n = int(np.asarray(multihost_utils.broadcast_one_to_all(
-                        np.int64(len(buf)), is_source=src)))
-                    pad = np.zeros(n, np.uint8)
-                    if src:
-                        pad[:len(buf)] = np.frombuffer(buf, np.uint8)
-                    g = np.asarray(multihost_utils.broadcast_one_to_all(
-                        pad, is_source=src))
-                    out.append(chain.decode_leaf(site, i, g.tobytes()))
-                return jax.tree.unflatten(treedef, out)
-            return multihost_utils.broadcast_one_to_all(
-                tree, is_source=jax.process_index() == root)
+    return _transport.default_stack().broadcast(tree, mesh, root=root,
+                                                site=site)
 
 
 def host_local_to_global(tree: Any, mesh: Mesh, pspec) -> Any:
-    """``multihost_utils.host_local_array_to_global_array`` behind the
-    parallel/ boundary (scripts/lint_collectives.py forbids direct use
-    elsewhere). No filtering: this is the device-feed assembly path —
-    the bytes move host→device, not across the DCN."""
-    from jax.experimental import multihost_utils
-    return multihost_utils.host_local_array_to_global_array(
+    """Host-local array → global sharded array behind the transport
+    boundary (scripts/lint_collectives.py forbids direct use of the
+    raw multi-controller API elsewhere). No filtering: this is the
+    device-feed assembly path — the bytes move host→device, not
+    across the DCN."""
+    return _transport.default_stack().host_local_to_global(
         tree, mesh, pspec)
-
-

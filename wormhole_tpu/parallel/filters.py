@@ -77,6 +77,9 @@ DEFAULT_LOSSY_SITES: Set[str] = {
     "async_sgd/auc_hist", # learners/async_sgd.py: pooled-AUC histograms
     "bench/grad_hist",    # bench.py comm_filters phase payload
     "ps/delta",           # ps engine: dense bucket-space grad windows
+    "hier/delta",         # hierarchical transport: host-level bucket
+                          # deltas on the cross-host leg (the in-mesh
+                          # ICI psum below them stays exact)
 }
 
 _FLAG_QUANT = 1

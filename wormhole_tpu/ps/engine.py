@@ -28,7 +28,8 @@ Correctness rests on two invariants:
 
 The transport is a closure per ticket: the engine never imports the
 collectives, so unit tests and the bench inject fake transports, while
-the real caller closes over ``allreduce_tree(..., site="ps/delta")`` —
+the real caller closes over an ``allreduce_tree`` call at
+``site="ps/delta"`` —
 keeping chaos injection, the watchdog guard (armed on THIS thread; see
 ft/watchdog.py's per-thread slots) and the filter chain's wire-byte
 accounting exactly where they already live.

@@ -178,6 +178,19 @@ class Config:
     # (rejoin machinery fully off; wire bytes and tau=0 parity are
     # untouched).
     rejoin_replay_windows: int = 0
+    # --- 2D hierarchical exchange (parallel/transport.py) ---
+    # hier_hosts > 0 arranges the run as that many hosts, each running
+    # its own (data, model) mesh over ICI, exchanging only host-level
+    # bucket deltas cross-host through the filtered wire. The cross-host
+    # leg rides staleness_tau unchanged: -1/0 = synchronous delta
+    # exchange per window (tau=0 is the BSP parity oracle), >= 1 lets
+    # each host run tau windows ahead through its ExchangeEngine.
+    # 0 = hierarchy off (flat single-level exchange, the default).
+    hier_hosts: int = 0
+    # per-host mesh geometry for the hierarchy, same grammar as
+    # mesh_shape (e.g. "data:2,model:2"); empty = each host puts all its
+    # local devices on "data". Ignored unless hier_hosts > 0.
+    hier_mesh_shape: str = ""
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Run the whole static-analysis suite in one process, one parse/file.
+
+All nine checkers (six migrated legacy lints + WH-DONATE, WH-THREAD,
+WH-HOSTSYNC) share a single engine pass over ``wormhole_tpu/``: one
+file read, one comment-strip and at most one AST parse per file,
+instead of six separate script invocations each rewalking the tree.
+
+Usage::
+
+    python scripts/lint.py                 # run everything
+    python scripts/lint.py --list          # show the checker catalog
+    python scripts/lint.py --only spans,donation
+    python scripts/lint.py --json          # machine-readable findings
+
+Exit codes: 0 all green, 1 findings, 2 tree layout missing (no
+wormhole_tpu/ package under --root).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from wormhole_tpu.analysis import Engine                   # noqa: E402
+from wormhole_tpu.analysis.checkers import (ALL_CHECKERS,  # noqa: E402
+                                            BY_NAME)
+
+
+def run(root: str, only=None, as_json=False) -> int:
+    names = list(only) if only else [c.name for c in ALL_CHECKERS]
+    unknown = [n for n in names if n not in BY_NAME]
+    if unknown:
+        print(f"lint: unknown checker(s): {', '.join(unknown)} "
+              f"(see --list)", file=sys.stderr)
+        return 2
+    checkers = [BY_NAME[n](root) for n in names]
+    ready = []
+    rc = 0
+    for chk in checkers:
+        err = chk.precheck()
+        if err is None:
+            ready.append(chk)
+        else:
+            print(err, file=sys.stderr)
+            rc = 2
+    if rc:
+        return rc
+    eng = Engine(root, ready)
+    diags = eng.run()
+    if as_json:
+        payload = {
+            "root": os.path.abspath(root),
+            "files": eng.files_scanned,
+            "parses": eng.parses,
+            "checkers": [
+                {"name": chk.name, "code": chk.code,
+                 "ok": not chk.diagnostics,
+                 "findings": [{"rel": d.rel, "line": d.line,
+                               "message": d.message}
+                              for d in chk.diagnostics],
+                 "warnings": list(chk.warnings)}
+                for chk in ready],
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 1 if diags else 0
+    for chk in ready:
+        for w in chk.warnings:
+            print(w, file=sys.stderr)
+    if diags:
+        for d in diags:
+            print(d.format(), file=sys.stderr)
+        bad = sorted({chk.name for chk in ready if chk.diagnostics})
+        print(f"lint: FAIL ({len(diags)} finding"
+              f"{'s' if len(diags) != 1 else ''} from "
+              f"{', '.join(bad)})", file=sys.stderr)
+        return 1
+    for chk in ready:
+        print(chk.ok_line())
+    print(f"lint: OK ({len(ready)} checkers, {eng.files_scanned} "
+          f"files, {eng.parses} parses)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=".",
+                    help="repo root containing wormhole_tpu/ "
+                         "(default: cwd)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the checker catalog and exit")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated checker names to run")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON on stdout")
+    args = ap.parse_args(argv)
+    if args.list:
+        for cls in ALL_CHECKERS:
+            mod = sys.modules[cls.__module__]
+            doc = (mod.__doc__ or "").strip().splitlines()
+            head = doc[0] if doc else ""
+            print(f"{cls.name:<12} {cls.code:<14} {head}")
+        return 0
+    only = ([n.strip() for n in args.only.split(",") if n.strip()]
+            if args.only else None)
+    return run(args.root, only=only, as_json=args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

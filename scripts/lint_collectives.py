@@ -1,42 +1,11 @@
 #!/usr/bin/env python
 """Lint: one transport layer, one marker form.
 
-Rule 1 — raw collective transport lives in exactly ONE file:
-``wormhole_tpu/parallel/transport.py`` (the ``ProcessWire``). Every
-other file in the package — including the rest of ``parallel/`` — must
-reach the wire through the transport stack (``parallel/collectives.py``
-delegates to it). A site that imports ``jax.experimental``'s multihost
-helpers directly bypasses the seq/span stamping, the watchdog guard,
-the ps-lite filter chain (parallel/filters.py — KEY_CACHING /
-FIXING_FLOAT / COMPRESSING) and the wire-byte accounting
-(``comm/bytes_raw`` etc.) — its payload ships unfiltered and its bytes
-vanish from the comm counters — so this lint fails the build until the
-site is rewritten against the wrappers or consciously allowlisted with
-a reason.
-
-Rule 2 — every collective call site outside ``wormhole_tpu/parallel/``
-(``allreduce_tree`` / ``allgather_tree`` / ``broadcast_tree``) must
-carry a single-form routing marker within the preceding few lines::
-
-    # transport: engine — <why this runs on the drain thread>
-    # transport: direct — <why this never coexists with a live engine>
-    # transport: mesh   — <in-jit psum leg; tree call is the fallback>
-
-``engine`` means the call routes through ``ExchangeEngine.submit /
-exchange`` (a second thread issuing its own collective can interleave
-differently across ranks and deadlock the mesh — the engine's single
-drain thread is the only thread allowed to block on the wire while a
-training pass is live). ``direct`` means the call provably never
-coexists with a live engine (BSP passes, startup/shutdown barriers,
-metrics windows the engine quiesces around). ``mesh`` marks a site
-whose hot path is the in-jit ICI psum and the tree call is a host-side
-fallback or reduction of the psum result. An unmarked site means
-nobody decided, which is how the deadlock ships.
-
-The checks are textual (rule 1 strips comments; rule 2 reads them),
-not an AST walk: they must catch lazy function-level imports and
-closures built inside call arguments, and false positives are resolved
-by the allowlist / a marker anyway.
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.collectives`` (WH-COLLECTIVE) and
+also runs via ``scripts/lint.py``. This script re-exports the legacy
+module API (``TRANSPORT_HOME``, ``ALLOWLIST``, ``scan_file``,
+``scan_markers``, ``run``) and keeps the legacy CLI and output.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -47,139 +16,27 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
-# The single file allowed to touch the raw wire.
-TRANSPORT_HOME = "wormhole_tpu/parallel/transport.py"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# Audited files outside TRANSPORT_HOME that legitimately reference
-# multihost_utils. Every entry carries the reason. Deliberately EMPTY:
-# the PR that unified the transport rewrote every call site against the
-# stack, and new entries should be rare and argued.
-ALLOWLIST: dict = {}
-
-_PAT = re.compile(r"\bmultihost_utils\b")
-
-# rule 2: collective call sites and their routing markers
-_CALL_PAT = re.compile(
-    r"\b(allreduce_tree|allgather_tree|broadcast_tree)\s*\(")
-_MARKER_PAT = re.compile(r"#\s*transport:\s*(\w+)")
-_ROUTES = ("engine", "direct", "mesh")
-_MARKER_WINDOW = 3   # marker may sit up to this many lines above the call
-
-# the retired two-marker form; flagged so stale markers don't linger as
-# dead annotations that LOOK like routing decisions
-_OLD_MARKER_PAT = re.compile(r"#\s*(ps-engine|bsp-direct):")
-
-
-def _strip_comments(text: str) -> str:
-    """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive about
-    `#` inside string literals — good enough for a lint whose false
-    positives land in a human-reviewed allowlist."""
-    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
-
-
-def scan_file(path: str) -> list:
-    """Return 1-based line numbers of multihost_utils references."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        text = _strip_comments(f.read())
-    return [text.count("\n", 0, m.start()) + 1
-            for m in _PAT.finditer(text)]
-
-
-def scan_markers(path: str) -> list:
-    """Rule 2: return ``(line, reason)`` for every collective call site
-    without a valid ``# transport: <route>`` marker on the call line or
-    the :data:`_MARKER_WINDOW` lines above it, plus every stale
-    old-form marker left in the file."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        raw = f.read()
-    raw_lines = raw.splitlines()
-    code_lines = _strip_comments(raw).splitlines()
-    out = []
-    for i, ln in enumerate(raw_lines):
-        if _OLD_MARKER_PAT.search(ln):
-            out.append((i + 1, "retired marker form (use `# transport: "
-                               "engine|direct|mesh`)"))
-    for i, ln in enumerate(code_lines):
-        m = _CALL_PAT.search(ln)
-        if m is None:
-            continue
-        lo = max(0, i - _MARKER_WINDOW)
-        marks = [_MARKER_PAT.search(r) for r in raw_lines[lo:i + 1]]
-        marks = [mk for mk in marks if mk is not None]
-        if not marks:
-            out.append((i + 1, f"{m.group(1)} without a `# transport:` "
-                               f"marker"))
-        elif not any(mk.group(1) in _ROUTES for mk in marks):
-            bad = ", ".join(sorted({mk.group(1) for mk in marks}))
-            out.append((i + 1, f"{m.group(1)} marker route {bad!r} not in "
-                               f"{'/'.join(_ROUTES)}"))
-    return out
-
-
-def run(root: str) -> int:
-    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
-    pkg = os.path.join(root, "wormhole_tpu")
-    if not os.path.isdir(pkg):
-        print(f"lint_collectives: no wormhole_tpu package under {root!r}",
-              file=sys.stderr)
-        return 2
-    violations = []
-    unmarked = []
-    seen_allowed = set()
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if rel == TRANSPORT_HOME:
-                continue  # the one file that owns the raw wire
-            if not rel.startswith("wormhole_tpu/parallel/"):
-                unmarked.extend(f"{rel}:{ln}: {why}"
-                                for ln, why in scan_markers(path))
-            lines = scan_file(path)
-            if not lines:
-                continue
-            if rel in ALLOWLIST:
-                seen_allowed.add(rel)
-            else:
-                violations.extend(f"{rel}:{ln}" for ln in lines)
-    for rel in sorted(set(ALLOWLIST) - seen_allowed):
-        # stale entries are a warning, not a failure: deleting the last
-        # reference from an audited file should not break the build
-        print(f"lint_collectives: allowlist entry {rel} has no "
-              f"multihost_utils references (stale?)", file=sys.stderr)
-    if violations:
-        print(f"lint_collectives: raw multihost transport outside "
-              f"{TRANSPORT_HOME}:", file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        print("route the call through the transport stack "
-              "(parallel/collectives.py allreduce_tree / allgather_tree "
-              "/ broadcast_tree / host_local_to_global, or "
-              "parallel/transport.py TransportStack) so it rides the "
-              "layer stack and the comm byte counters, or add the file "
-              "to ALLOWLIST in scripts/lint_collectives.py with a reason",
-              file=sys.stderr)
-        return 1
-    if unmarked:
-        print("lint_collectives: collective call sites without a valid "
-              "routing marker:", file=sys.stderr)
-        for v in unmarked:
-            print(f"  {v}", file=sys.stderr)
-        print("mark the site `# transport: engine` (it runs on the "
-              "exchange engine's drain thread — ExchangeEngine.submit/"
-              "exchange, e.g. via AsyncSGD._ctl), `# transport: direct` "
-              "(it provably never coexists with a live engine) or "
-              "`# transport: mesh` (host-side leg of the in-jit psum "
-              f"path) within {_MARKER_WINDOW} lines above the call",
-              file=sys.stderr)
-        return 1
-    print(f"lint_collectives: OK ({len(seen_allowed)} allowlisted files)")
-    return 0
+from wormhole_tpu.analysis.checkers.collectives import (  # noqa: E402,F401
+    ALLOWLIST,
+    TRANSPORT_HOME,
+    CollectiveChecker,
+    _CALL_PAT,
+    _MARKER_PAT,
+    _MARKER_WINDOW,
+    _OLD_MARKER_PAT,
+    _PAT,
+    _ROUTES,
+    _strip_comments,
+    run,
+    scan_file,
+    scan_markers,
+)
 
 
 def main(argv=None) -> int:

@@ -1,23 +1,11 @@
 #!/usr/bin/env python
 """Lint: every Config knob is documented; every metric name is unique.
 
-Two rules, both born of the obs/ PR:
-
-1. **Knob coverage** — every field of ``wormhole_tpu.utils.config.Config``
-   must appear somewhere under ``docs/*.md`` (the reference table lives
-   in docs/config.md). A knob nobody can discover is a knob nobody can
-   turn; the reference ships config.proto with inline docs for the same
-   reason. Fields are extracted by AST walk (no jax import needed), so
-   the lint runs anywhere.
-
-2. **Metric-name uniqueness** — every literal metric name declared
-   against a registry (``.counter("name")`` / ``.gauge("name")`` /
-   ``.histogram("name")`` in ``wormhole_tpu/``) must be declared at
-   exactly one site. Two sites declaring the same name silently merge
-   their streams (Registry returns the existing metric), which is the
-   observability version of two writers on one file. The registry
-   enforces kind-mismatch at runtime; this lint catches the same-kind
-   collision that runtime cannot distinguish from intent.
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.knobs`` (WH-KNOB) and also runs via
+``scripts/lint.py``. This script re-exports the legacy module API
+(``config_fields``, ``metric_sites``, ``duplicate_metrics``, ``run``,
+...) and keeps the legacy CLI and output.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -27,114 +15,24 @@ Run from the repo root (or pass ``--root``)::
 from __future__ import annotations
 
 import argparse
-import ast
-import glob
 import os
-import re
 import sys
 
-# Config fields that may legitimately stay out of docs/. Every entry
-# carries a reason; keep this empty-by-default bias — documenting the
-# knob is almost always cheaper than explaining why not.
-KNOB_ALLOWLIST = {}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# `.counter("x")` / `.gauge("x")` / `.histogram("x")` with a literal
-# first argument — declaration sites the uniqueness rule applies to.
-# Computed names (`prefix + k`) are adapter plumbing, not declarations.
-_METRIC_PAT = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*['\"]([^'\"]+)['\"]")
-
-
-def config_fields(root: str) -> list:
-    """Config's annotated field names, by AST (import-free)."""
-    path = os.path.join(root, "wormhole_tpu", "utils", "config.py")
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "Config":
-            return [st.target.id for st in node.body
-                    if isinstance(st, ast.AnnAssign)
-                    and isinstance(st.target, ast.Name)]
-    raise RuntimeError(f"no Config class found in {path}")
-
-
-def documented_text(root: str) -> str:
-    parts = []
-    for p in sorted(glob.glob(os.path.join(root, "docs", "*.md"))):
-        with open(p, "r", encoding="utf-8", errors="replace") as f:
-            parts.append(f.read())
-    return "\n".join(parts)
-
-
-def undocumented_knobs(root: str) -> list:
-    docs = documented_text(root)
-    missing = []
-    for name in config_fields(root):
-        if name in KNOB_ALLOWLIST:
-            continue
-        # word-boundary match: `minibatch` in prose, a table row, or a
-        # `key=value` example all count; substrings of other words don't
-        if not re.search(rf"\b{re.escape(name)}\b", docs):
-            missing.append(name)
-    return missing
-
-
-def metric_sites(root: str) -> dict:
-    """name -> ["file:line", ...] of literal metric declarations."""
-    sites: dict = {}
-    pkg = os.path.join(root, "wormhole_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path, "r", encoding="utf-8",
-                      errors="replace") as f:
-                text = f.read()
-            for m in _METRIC_PAT.finditer(text):
-                ln = text.count("\n", 0, m.start()) + 1
-                sites.setdefault(m.group(2), []).append(f"{rel}:{ln}")
-    return sites
-
-
-def duplicate_metrics(root: str) -> dict:
-    return {name: where for name, where in metric_sites(root).items()
-            if len(where) > 1}
-
-
-def run(root: str) -> int:
-    """Run both rules; return a process rc."""
-    if not os.path.isdir(os.path.join(root, "wormhole_tpu")):
-        print(f"lint_knobs: no wormhole_tpu package under {root!r}",
-              file=sys.stderr)
-        return 2
-    rc = 0
-    missing = undocumented_knobs(root)
-    if missing:
-        rc = 1
-        print("lint_knobs: Config fields missing from docs/*.md:",
-              file=sys.stderr)
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        print("add a row to docs/config.md (or, with a reason, to "
-              "KNOB_ALLOWLIST in scripts/lint_knobs.py)",
-              file=sys.stderr)
-    dups = duplicate_metrics(root)
-    if dups:
-        rc = 1
-        print("lint_knobs: metric names declared at multiple sites:",
-              file=sys.stderr)
-        for name, where in sorted(dups.items()):
-            print(f"  {name}: {', '.join(where)}", file=sys.stderr)
-        print("declare each metric once and pass the object around "
-              "(two declaration sites silently merge their streams)",
-              file=sys.stderr)
-    if rc == 0:
-        n = len(config_fields(root))
-        print(f"lint_knobs: OK ({n} knobs documented, "
-              f"{len(metric_sites(root))} unique metric names)")
-    return rc
+from wormhole_tpu.analysis.checkers.knobs import (  # noqa: E402,F401
+    KNOB_ALLOWLIST,
+    KnobChecker,
+    _METRIC_PAT,
+    config_fields,
+    documented_text,
+    duplicate_metrics,
+    metric_sites,
+    run,
+    undocumented_knobs,
+)
 
 
 def main(argv=None) -> int:

@@ -1,17 +1,12 @@
 #!/usr/bin/env python
 """Lint: no serialized scatter-adds (``.at[...].add``) outside the allowlist.
 
-XLA:TPU lowers ``x.at[idx].add(v)`` to a serialized per-element update
-loop (~13-25ns/element), which is exactly the pathology ops/tilemm.py and
-ops/histmm.py exist to avoid: both reformulate the scatter as a one-hot
-matmul on the MXU. This lint keeps the win from regressing — a new
-``.at[...].add`` in an unaudited file fails the build until it is either
-rewritten as a matmul or consciously added below with a reason.
-
-The check is textual (comments stripped, bracket contents may span
-lines), not an AST walk: it must catch the pattern inside strings being
-exec'd or built up for pallas too, and false positives are resolved by
-the allowlist anyway.
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.scatters`` (WH-SCATTER) and also runs
+via ``scripts/lint.py``. This script re-exports the legacy module API
+(tables, ``scan_file``, ``unannotated_sites``, ``run``) and keeps the
+legacy CLI and output so existing tests and muscle memory keep
+working.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -22,140 +17,23 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
-# Audited files that legitimately keep `.at[...].add` sites. Every entry
-# carries the reason the scatter is acceptable there. models/gbdt.py is
-# deliberately ABSENT: its level-histogram scatters moved to ops/histmm
-# (PR 2) and must not come back.
-ALLOWLIST = {
-    "wormhole_tpu/ops/spmv.py":
-        "documented scatter fallback for the y = A^T x product; the "
-        "matmul path is the default, this is the oracle",
-    "wormhole_tpu/ops/tilemm.py":
-        "COO overflow-bucket spill: O(overflow) elements, not O(nnz); "
-        "the hot tile path is already a one-hot matmul",
-    "wormhole_tpu/ops/histmm.py":
-        "the scatter ORACLE kernels (_dense_scatter/_sparse_scatter) "
-        "that the matmul kernels are parity-tested against",
-    "wormhole_tpu/solver/lbfgs.py":
-        "two-loop recursion history update: O(lbfgs_memory) ~ 10 "
-        "elements, nothing to vectorize",
-    "wormhole_tpu/models/kmeans.py":
-        "per-cluster count/weight stats: O(clusters) cells, dominated "
-        "by the distance matmul",
-}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# Files whose scatters are live RUNTIME fallbacks — the paths the online
-# tile encoder (data/crec.TileOnlineFeed) and the `tile_online=auto`
-# admission gate route real traffic through when the tile path is
-# inadmissible. A blanket allowlist would let new, unrelated scatters
-# hide in these hot files, so instead EVERY `.at[...].add` site here must
-# carry a `scatter-fallback:` comment (same line or the two lines above)
-# saying why that particular scatter stays.
-ANNOTATED = {
-    "wormhole_tpu/learners/store.py":
-        "uniq-key push, v1 dense-apply grad, overflow spills",
-    "wormhole_tpu/models/fm.py":
-        "uniq-key push + tile overflow spill",
-    "wormhole_tpu/models/wide_deep.py":
-        "uniq-key push + tile overflow spill",
-}
-
-# the in-source audit marker required at each scatter site in ANNOTATED
-# files (comment text, so it survives _strip_comments only in raw form)
-MARKER = "scatter-fallback:"
-
-# `.at[` ... `].add(` with the subscript allowed to span lines; targets
-# only scatter-ADD — `.at[].set/.max/.min/.mul` have different lowering
-# and are not what tilemm/histmm replace.
-_PAT = re.compile(r"\.at\s*\[[^\]]*\]\s*\.add\s*\(", re.S)
-
-
-def _strip_comments(text: str) -> str:
-    """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive about
-    `#` inside string literals — good enough for a lint whose false
-    positives land in a human-reviewed allowlist."""
-    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
-
-
-def scan_file(path: str) -> list:
-    """Return 1-based line numbers of scatter-add sites in ``path``."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        text = _strip_comments(f.read())
-    return [text.count("\n", 0, m.start()) + 1
-            for m in _PAT.finditer(text)]
-
-
-def unannotated_sites(path: str, lines: list) -> list:
-    """Scatter sites (1-based line numbers) lacking the ``MARKER``
-    comment on the same line or within the two preceding lines."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        raw = f.read().splitlines()
-    out = []
-    for ln in lines:
-        window = raw[max(ln - 3, 0):ln]
-        if not any(MARKER in w for w in window):
-            out.append(ln)
-    return out
-
-
-def run(root: str) -> int:
-    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
-    pkg = os.path.join(root, "wormhole_tpu")
-    if not os.path.isdir(pkg):
-        print(f"lint_scatters: no wormhole_tpu package under {root!r}",
-              file=sys.stderr)
-        return 2
-    violations = []
-    unannotated = []
-    seen_allowed = set()
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            lines = scan_file(path)
-            if not lines:
-                continue
-            if rel in ANNOTATED:
-                seen_allowed.add(rel)
-                unannotated.extend(
-                    f"{rel}:{ln}"
-                    for ln in unannotated_sites(path, lines))
-            elif rel in ALLOWLIST:
-                seen_allowed.add(rel)
-            else:
-                violations.extend(f"{rel}:{ln}" for ln in lines)
-    for rel in sorted((set(ALLOWLIST) | set(ANNOTATED)) - seen_allowed):
-        # stale entries are a warning, not a failure: deleting the last
-        # scatter from an audited file should not break the build
-        print(f"lint_scatters: allowlist entry {rel} has no "
-              f"scatter-adds (stale?)", file=sys.stderr)
-    if violations:
-        print("lint_scatters: serialized scatter-add (`.at[...].add`) "
-              "outside the allowlist:", file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        print("either reformulate as a one-hot matmul (see ops/histmm.py"
-              " / ops/tilemm.py) or add the file to ALLOWLIST in "
-              "scripts/lint_scatters.py with a reason", file=sys.stderr)
-    if unannotated:
-        print("lint_scatters: runtime-fallback scatter without a "
-              f"`{MARKER}` audit comment (same line or the two lines "
-              "above):", file=sys.stderr)
-        for v in unannotated:
-            print(f"  {v}", file=sys.stderr)
-        print("these files carry live scatter fallbacks (the online "
-              "tile-encode overflow route); each site must say why it "
-              "stays a scatter", file=sys.stderr)
-    if violations or unannotated:
-        return 1
-    print(f"lint_scatters: OK ({len(seen_allowed)} audited files, "
-          f"{len(ANNOTATED)} annotated)")
-    return 0
+from wormhole_tpu.analysis.checkers.scatters import (  # noqa: E402,F401
+    ALLOWLIST,
+    ANNOTATED,
+    MARKER,
+    ScatterChecker,
+    _PAT,
+    _strip_comments,
+    run,
+    scan_file,
+    unannotated_sites,
+)
 
 
 def main(argv=None) -> int:

@@ -1,18 +1,11 @@
 #!/usr/bin/env python
 """Lint: nothing under wormhole_tpu/serve/ may touch a training entry point.
 
-The serving tier's one invariant is that it is PULL-ONLY (the reference
-worker's ZPull without the ZPush half): it reads model snapshots and
-computes margins; it never updates parameters, never touches optimizer
-state, never scatters into a table. The invariant is what makes the
-hot-swap sound — a serve-side write would race the training loop and
-tear the "one consistent model per batch" guarantee the swap provides.
-
-This lint enforces it statically: every Python file under
-``wormhole_tpu/serve/`` is scanned (comments stripped) for the training
-store's mutation surface — push/update/optimizer entry points and raw
-scatter-adds. A serving feature that needs any of these is not a
-serving feature; it belongs in learners/ behind the store API.
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.serve`` (WH-SERVE) and also runs via
+``scripts/lint.py``. This script re-exports the legacy module API
+(``FORBIDDEN``, ``scan_file``, ``run``) and keeps the legacy CLI and
+output.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -23,80 +16,19 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
-# The training mutation surface, as call-site patterns. Textual on
-# purpose (same rationale as lint_scatters): it must catch the names
-# inside strings being exec'd or built dynamically too, and a false
-# positive in serve/ code is itself a smell worth renaming away.
-FORBIDDEN = [
-    # fused/tile/dense training steps (store.train_step, tile_train_step,
-    # _dense_step train kind is reached only through train_step)
-    (re.compile(r"\btrain_step\b"), "training step dispatch"),
-    # delay-tolerant split pipeline (DT2 pull computes gradients and its
-    # push applies them; BOTH are training-only)
-    (re.compile(r"\bdt2_push\b"), "DT2 delayed push"),
-    (re.compile(r"\bdt2_pull\b"), "DT2 gradient pull (training half)"),
-    # handle/optimizer update entry points
-    (re.compile(r"\.push\s*\("), "parameter push (optimizer update)"),
-    (re.compile(r"\bmasked_push\b"), "masked parameter push"),
-    (re.compile(r"\bbackward_grad\b"), "gradient computation for push"),
-    (re.compile(r"\bbackward_pushes\b"), "tile backward push pipeline"),
-    # raw scatter-add into a table (the push primitive itself)
-    (re.compile(r"\.at\s*\[[^\]]*\]\s*\.add\s*\(", re.S),
-     "scatter-add into a parameter table"),
-    # restoring state INTO the training store from serve code would be a
-    # write to the trainer's model; serve loads into its own standby
-    (re.compile(r"\brestore_pytree\b"), "training-store state restore"),
-]
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-
-def _strip_comments(text: str) -> str:
-    """Drop `#`-to-EOL per line (keeps line numbers aligned)."""
-    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
-
-
-def scan_file(path: str) -> list:
-    """Return ``(line, reason)`` violations in ``path``."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        text = _strip_comments(f.read())
-    out = []
-    for pat, reason in FORBIDDEN:
-        out.extend((text.count("\n", 0, m.start()) + 1, reason)
-                   for m in pat.finditer(text))
-    return sorted(out)
-
-
-def run(root: str) -> int:
-    """Scan ``root``/wormhole_tpu/serve for violations; return an rc."""
-    pkg = os.path.join(root, "wormhole_tpu", "serve")
-    if not os.path.isdir(pkg):
-        print(f"lint_serve: no wormhole_tpu/serve package under {root!r}",
-              file=sys.stderr)
-        return 2
-    violations = []
-    nfiles = 0
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            nfiles += 1
-            violations.extend(f"{rel}:{ln}: {reason}"
-                              for ln, reason in scan_file(path))
-    if violations:
-        print("lint_serve: serving code reaching a training mutation "
-              "entry point (serve/ is pull-only):", file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        print("serving must never push/update/scatter — if the feature "
-              "needs writes, it belongs in learners/ behind the store "
-              "API, not under wormhole_tpu/serve/", file=sys.stderr)
-        return 1
-    print(f"lint_serve: OK ({nfiles} serve files pull-only)")
-    return 0
+from wormhole_tpu.analysis.checkers.serve import (  # noqa: E402,F401
+    FORBIDDEN,
+    ServeChecker,
+    _strip_comments,
+    run,
+    scan_file,
+)
 
 
 def main(argv=None) -> int:

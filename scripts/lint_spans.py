@@ -1,25 +1,11 @@
 #!/usr/bin/env python
 """Lint: every span name is declared once in the central span table.
 
-The step ledger (``wormhole_tpu/obs/ledger.py``) folds trace spans into
-wall-time buckets by name. A renamed instrumentation site would silently
-fall out of its bucket and into ``other``/``unattributed`` — the
-observability version of the silent metric fork ``lint_knobs`` guards
-against. Two rules:
-
-1. **Declaration coverage** — every span name used at an
-   instrumentation site (literal or literal-prefixed first argument to
-   ``Timer.scope`` / ``trace.span`` / ``trace.complete`` under
-   ``wormhole_tpu/``) must resolve through ``SPAN_TABLE``: an exact
-   entry, a ``prefix*`` pattern, the ``eval_`` fold, the ``_stall``
-   rule, or the DeviceFeed ``<feed>:<stage>`` stage rule. Fully dynamic
-   names (``f"{self.name}:{label}"`` — the DeviceFeed relay and
-   ``Timer.scope``'s own forwarding) carry no literal and are resolved
-   at runtime by the same stage rules; this lint covers every site a
-   rename could silently break.
-2. **Single declaration site** — ``SPAN_TABLE`` itself is assigned at
-   exactly one place under ``wormhole_tpu/``, and its dict literal has
-   no duplicate keys (Python would silently keep the last one).
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.spans`` (WH-SPAN) and also runs via
+``scripts/lint.py``. This script re-exports the legacy module API
+(``span_table``, ``span_sites``, ``_resolves``, ``undeclared_spans``,
+``run``) and keeps the legacy CLI and output.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -29,152 +15,24 @@ Run from the repo root (or pass ``--root``)::
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
-# literal (or `pfx + "literal"`) first args to Timer.scope — the timer
-# relays the name into trace.complete verbatim (modulo the prefix,
-# which instrumentation only uses for the eval_ fold)
-_SCOPE_PAT = re.compile(r"\.scope\(\s*(?:\w+\s*\+\s*)?['\"]([^'\"]+)['\"]")
-# literal span/complete names
-_SPAN_LIT_PAT = re.compile(
-    r"trace\.(?:span|complete)\(\s*['\"]([^'\"]+)['\"]")
-# f-string span/complete names with a literal prefix before the first
-# placeholder (e.g. f"collective:allreduce_{op}") — the prefix must
-# match a `prefix*` table pattern
-_SPAN_FPAT = re.compile(
-    r"trace\.(?:span|complete)\(\s*f['\"]([^'\"{}]+)\{")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-
-def span_table(root: str):
-    """(keys, duplicate_keys, declaration_sites) of SPAN_TABLE, by AST
-    walk over ``wormhole_tpu/`` (import-free, works on synthetic trees)."""
-    keys: list = []
-    dups: list = []
-    sites: list = []
-    pkg = os.path.join(root, "wormhole_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path, "r", encoding="utf-8",
-                      errors="replace") as f:
-                try:
-                    tree = ast.parse(f.read(), path)
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                targets = []
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, ast.AnnAssign) and node.value:
-                    targets = [node.target]
-                if not any(isinstance(t, ast.Name)
-                           and t.id == "SPAN_TABLE" for t in targets):
-                    continue
-                sites.append(f"{rel}:{node.lineno}")
-                val = node.value
-                if isinstance(val, ast.Dict):
-                    seen = set()
-                    for k in val.keys:
-                        if isinstance(k, ast.Constant) \
-                                and isinstance(k.value, str):
-                            if k.value in seen:
-                                dups.append(k.value)
-                            seen.add(k.value)
-                            keys.append(k.value)
-    return keys, dups, sites
-
-
-def span_sites(root: str) -> dict:
-    """(name, is_prefix) -> ["file:line", ...] of span instrumentation
-    sites with a literal (or literal-prefixed) name."""
-    sites: dict = {}
-    pkg = os.path.join(root, "wormhole_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path, "r", encoding="utf-8",
-                      errors="replace") as f:
-                text = f.read()
-            for pat, is_prefix in ((_SCOPE_PAT, False),
-                                   (_SPAN_LIT_PAT, False),
-                                   (_SPAN_FPAT, True)):
-                for m in pat.finditer(text):
-                    ln = text.count("\n", 0, m.start()) + 1
-                    sites.setdefault((m.group(1), is_prefix),
-                                     []).append(f"{rel}:{ln}")
-    return sites
-
-
-def _resolves(name: str, is_prefix: bool, keys: list) -> bool:
-    """Mirror of obs.ledger.span_bucket's matching rules, against the
-    AST-extracted table (so synthetic test trees lint standalone)."""
-    if is_prefix:
-        # an f-string prefix matches any * pattern on the same stem
-        return any(k.endswith("*")
-                   and (k[:-1].startswith(name) or name.startswith(k[:-1]))
-                   for k in keys)
-    if name in keys:
-        return True
-    if name.startswith("eval_"):
-        return _resolves(name[5:], False, keys)
-    if name.endswith("_stall"):
-        return True
-    if any(k.endswith("*") and name.startswith(k[:-1]) for k in keys):
-        return True
-    if ":" in name:
-        return name.rsplit(":", 1)[1] in keys
-    return False
-
-
-def undeclared_spans(root: str) -> dict:
-    keys, _dups, _sites = span_table(root)
-    return {name: where
-            for (name, is_prefix), where in sorted(span_sites(root).items())
-            if not _resolves(name, is_prefix, keys)}
-
-
-def run(root: str) -> int:
-    if not os.path.isdir(os.path.join(root, "wormhole_tpu")):
-        print(f"lint_spans: no wormhole_tpu package under {root!r}",
-              file=sys.stderr)
-        return 2
-    rc = 0
-    keys, dups, decl_sites = span_table(root)
-    if len(decl_sites) != 1:
-        rc = 1
-        print(f"lint_spans: SPAN_TABLE declared at {len(decl_sites)} "
-              f"sites (want exactly 1): {', '.join(decl_sites) or 'none'}",
-              file=sys.stderr)
-    if dups:
-        rc = 1
-        print("lint_spans: duplicate SPAN_TABLE keys (the dict literal "
-              "silently keeps the last):", file=sys.stderr)
-        for k in dups:
-            print(f"  {k}", file=sys.stderr)
-    missing = undeclared_spans(root)
-    if missing:
-        rc = 1
-        print("lint_spans: span names used but not declared in "
-              "SPAN_TABLE (obs/ledger.py):", file=sys.stderr)
-        for name, where in sorted(missing.items()):
-            print(f"  {name}: {', '.join(where)}", file=sys.stderr)
-        print("add the span to SPAN_TABLE with its ledger bucket — an "
-              "undeclared span falls out of the wall-time attribution",
-              file=sys.stderr)
-    if rc == 0:
-        n_sites = sum(len(w) for w in span_sites(root).values())
-        print(f"lint_spans: OK ({n_sites} instrumentation sites resolve "
-              f"through {len(keys)} table entries)")
-    return rc
+from wormhole_tpu.analysis.checkers.spans import (  # noqa: E402,F401
+    SpanChecker,
+    _SCOPE_PAT,
+    _SPAN_FPAT,
+    _SPAN_LIT_PAT,
+    _resolves,
+    run,
+    span_sites,
+    span_table,
+    undeclared_spans,
+)
 
 
 def main(argv=None) -> int:

@@ -1,27 +1,12 @@
 #!/usr/bin/env python
 """Lint: every timeline series name is declared once in SERIES_TABLE.
 
-The timeline plane (``wormhole_tpu/obs/timeline.py``) emits per-sample
-series the SLO tracker (``obs/slo.py``), ``timeline.summarize``, and
-``bench_check.py --slo`` read back by name. A renamed series — or an
-SLO objective pointed at a metric that no longer exists — fails
-*silently*: the objective just never sees a value, and the burn rate
-stays 0 forever. Same failure class ``lint_spans.py`` guards for span
-names and ``lint_knobs.py`` for metric names; same cure:
-
-1. **Single declaration site** — ``SERIES_TABLE`` is assigned at
-   exactly one place under ``wormhole_tpu/`` and its dict literal has
-   no duplicate keys (Python silently keeps the last one).
-2. **Objective coverage** — every literal series name handed to an
-   ``Objective(...)`` under ``wormhole_tpu/`` must resolve: an exact
-   ``SERIES_TABLE`` entry, a registry metric name (the lint_knobs
-   declaration sites), or a declared ``*suffix`` derived rule over a
-   registry metric (``serve/latency_s_p99`` = histogram + ``*_p99``).
-3. **Derived-suffix coverage** — every literal ``+ "_suffix"`` series
-   emission in ``obs/timeline.py`` must match a ``*suffix`` entry.
-4. **Field coverage** — every keyword the sampler stamps through
-   ``Registry.record(...)`` in ``obs/timeline.py`` must be declared a
-   ``field`` entry (as must the ``ts``/``mono`` stamps record adds).
+Thin shim: the checker now lives on the shared analysis engine as
+``wormhole_tpu.analysis.checkers.timeline`` (WH-TIMELINE) and also
+runs via ``scripts/lint.py``. This script re-exports the legacy module
+API (``series_table``, ``metric_names``, ``objective_series``,
+``derived_suffixes``, ``record_fields``, ``_resolves``, ``run``) and
+keeps the legacy CLI and output.
 
 Run from the repo root (or pass ``--root``)::
 
@@ -31,205 +16,25 @@ Run from the repo root (or pass ``--root``)::
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
-# registry metric declaration sites (the lint_knobs contract)
-_METRIC_PAT = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*['\"]([^'\"]+)['\"]")
-# literal derived-suffix concatenations in the sampler
-_SUFFIX_PAT = re.compile(r"\+\s*['\"](_[a-z0-9]+)['\"]")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-
-def _walk_py(root: str):
-    pkg = os.path.join(root, "wormhole_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                path = os.path.join(dirpath, fn)
-                yield path, os.path.relpath(path, root).replace(
-                    os.sep, "/")
-
-
-def series_table(root: str):
-    """(keys, duplicate_keys, declaration_sites) of SERIES_TABLE by AST
-    walk (import-free, works on synthetic trees)."""
-    keys: list = []
-    dups: list = []
-    sites: list = []
-    for path, rel in _walk_py(root):
-        with open(path, "r", encoding="utf-8", errors="replace") as f:
-            try:
-                tree = ast.parse(f.read(), path)
-            except SyntaxError:
-                continue
-        for node in ast.walk(tree):
-            targets = []
-            if isinstance(node, ast.Assign):
-                targets = node.targets
-            elif isinstance(node, ast.AnnAssign) and node.value:
-                targets = [node.target]
-            if not any(isinstance(t, ast.Name)
-                       and t.id == "SERIES_TABLE" for t in targets):
-                continue
-            sites.append(f"{rel}:{node.lineno}")
-            val = node.value
-            if isinstance(val, ast.Dict):
-                seen = set()
-                for k in val.keys:
-                    if isinstance(k, ast.Constant) \
-                            and isinstance(k.value, str):
-                        if k.value in seen:
-                            dups.append(k.value)
-                        seen.add(k.value)
-                        keys.append(k.value)
-    return keys, dups, sites
-
-
-def metric_names(root: str) -> set:
-    """Every literal registry metric name declared under wormhole_tpu/
-    (counter/gauge/histogram call sites — the lint_knobs pattern)."""
-    out: set = set()
-    for path, _rel in _walk_py(root):
-        with open(path, "r", encoding="utf-8", errors="replace") as f:
-            out.update(_METRIC_PAT.findall(f.read()))
-    return out
-
-
-def objective_series(root: str) -> dict:
-    """series-name -> ["file:line", ...] for every literal series
-    handed to an Objective(...) construction."""
-    sites: dict = {}
-    for path, rel in _walk_py(root):
-        with open(path, "r", encoding="utf-8", errors="replace") as f:
-            try:
-                tree = ast.parse(f.read(), path)
-            except SyntaxError:
-                continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fname = (node.func.id if isinstance(node.func, ast.Name)
-                     else node.func.attr
-                     if isinstance(node.func, ast.Attribute) else "")
-            if fname != "Objective":
-                continue
-            series = None
-            if len(node.args) >= 2 \
-                    and isinstance(node.args[1], ast.Constant) \
-                    and isinstance(node.args[1].value, str):
-                series = node.args[1].value
-            for kw in node.keywords:
-                if kw.arg == "series" \
-                        and isinstance(kw.value, ast.Constant) \
-                        and isinstance(kw.value.value, str):
-                    series = kw.value.value
-            if series is not None:
-                sites.setdefault(series, []).append(
-                    f"{rel}:{node.lineno}")
-    return sites
-
-
-def derived_suffixes(root: str) -> dict:
-    """suffix -> ["file:line", ...] of literal `+ "_suffix"` series
-    emissions in the sampler module."""
-    sites: dict = {}
-    path = os.path.join(root, "wormhole_tpu", "obs", "timeline.py")
-    if not os.path.exists(path):
-        return sites
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        text = f.read()
-    for m in _SUFFIX_PAT.finditer(text):
-        ln = text.count("\n", 0, m.start()) + 1
-        sites.setdefault(m.group(1), []).append(
-            f"wormhole_tpu/obs/timeline.py:{ln}")
-    return sites
-
-
-def record_fields(root: str) -> dict:
-    """field -> ["file:line", ...] of keywords the sampler stamps via
-    Registry.record(...), plus the ts/mono stamps record itself adds."""
-    sites: dict = {}
-    path = os.path.join(root, "wormhole_tpu", "obs", "timeline.py")
-    if not os.path.exists(path):
-        return sites
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        try:
-            tree = ast.parse(f.read(), path)
-        except SyntaxError:
-            return sites
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "record":
-            for kw in node.keywords:
-                if kw.arg:
-                    sites.setdefault(kw.arg, []).append(
-                        f"wormhole_tpu/obs/timeline.py:{node.lineno}")
-            for stamp in ("ts", "mono"):   # Registry.record stamps
-                sites.setdefault(stamp, []).append(
-                    f"wormhole_tpu/obs/timeline.py:{node.lineno}")
-    return sites
-
-
-def _resolves(series: str, keys: list, metrics: set) -> bool:
-    """A series resolves through an exact table entry, a registry
-    metric name, or a declared `*suffix` rule over a registry metric
-    (p50/p99/rate series derived by the sampler)."""
-    if series in keys or series in metrics:
-        return True
-    for k in keys:
-        if k.startswith("*") and series.endswith(k[1:]):
-            stem = series[:-len(k[1:])]
-            if stem in metrics or stem in keys:
-                return True
-    return False
-
-
-def run(root: str) -> int:
-    if not os.path.isdir(os.path.join(root, "wormhole_tpu")):
-        print(f"lint_timeline: no wormhole_tpu package under {root!r}",
-              file=sys.stderr)
-        return 2
-    rc = 0
-    keys, dups, decl_sites = series_table(root)
-    if len(decl_sites) != 1:
-        rc = 1
-        print(f"lint_timeline: SERIES_TABLE declared at "
-              f"{len(decl_sites)} sites (want exactly 1): "
-              f"{', '.join(decl_sites) or 'none'}", file=sys.stderr)
-    if dups:
-        rc = 1
-        print("lint_timeline: duplicate SERIES_TABLE keys (the dict "
-              "literal silently keeps the last):", file=sys.stderr)
-        for k in dups:
-            print(f"  {k}", file=sys.stderr)
-    metrics = metric_names(root)
-    checked = 0
-    for label, sites in (("objective series", objective_series(root)),
-                         ("record field", record_fields(root))):
-        for name, where in sorted(sites.items()):
-            checked += 1
-            ok = (_resolves(name, keys, metrics) if label !=
-                  "record field" else name in keys)
-            if not ok:
-                rc = 1
-                print(f"lint_timeline: {label} {name!r} does not "
-                      f"resolve through SERIES_TABLE "
-                      f"({', '.join(where)})", file=sys.stderr)
-    for suffix, where in sorted(derived_suffixes(root).items()):
-        checked += 1
-        if "*" + suffix not in keys:
-            rc = 1
-            print(f"lint_timeline: derived suffix {suffix!r} emitted "
-                  f"without a '*{suffix}' SERIES_TABLE entry "
-                  f"({', '.join(where)})", file=sys.stderr)
-    if rc == 0:
-        print(f"lint_timeline: OK ({checked} series sites resolve "
-              f"through {len(keys)} table entries)")
-    return rc
+from wormhole_tpu.analysis.checkers.timeline import (  # noqa: E402,F401
+    TimelineChecker,
+    _METRIC_PAT,
+    _SUFFIX_PAT,
+    _resolves,
+    derived_suffixes,
+    metric_names,
+    objective_series,
+    record_fields,
+    run,
+    series_table,
+)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,409 @@
+"""Planted-violation fixtures for the three new analyses (WH-DONATE,
+WH-THREAD, WH-HOSTSYNC): each checker fires on its planted bug at the
+right line, stays silent once the site is fixed or audit-marked, and
+never cascades."""
+
+import os
+import textwrap
+
+import pytest
+
+from wormhole_tpu.analysis import Engine
+from wormhole_tpu.analysis.checkers.donation import DonationChecker
+from wormhole_tpu.analysis.checkers.hostsync import HostSyncChecker
+from wormhole_tpu.analysis.checkers.threads import ThreadChecker
+
+
+def _run(tmp_path, cls, source, rel="mod.py"):
+    p = tmp_path / "wormhole_tpu" / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    chk = cls(str(tmp_path))
+    diags = Engine(str(tmp_path), [chk]).run()
+    return diags
+
+
+# -- WH-DONATE ---------------------------------------------------------------
+
+# the PR 10 bug shape, verbatim: the fused step donates its input, the
+# loop stores the returned ticket in a long-lived alias, and the await
+# lands AFTER the next iteration's dispatch already re-donated the
+# buffer the alias points at
+_DONATE_LOOP = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def fused_step(state):
+    return state
+
+def train(state, steps):
+    ticket = None
+    for _ in range(steps):
+        state = fused_step(state)
+        ticket = state
+    jax.block_until_ready(ticket)
+    return state
+"""
+
+
+def test_donate_flags_loop_carried_store_at_await_line(tmp_path):
+    diags = _run(tmp_path, DonationChecker, _DONATE_LOOP)
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "WH-DONATE"
+    assert d.line == 13          # the jax.block_until_ready(ticket) line
+    assert "'ticket'" in d.message
+    assert "fused_step" in d.message
+
+
+def test_donate_flags_straight_line_redispatch(tmp_path):
+    diags = _run(tmp_path, DonationChecker, """\
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def go(a, b):
+            x = step(a)
+            step(b)
+            jax.block_until_ready(x)
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 8
+    assert "may have re-donated" in diags[0].message
+
+
+def test_donate_silent_on_await_before_next_dispatch(tmp_path):
+    # the legal pattern: resolve the ticket before re-dispatching
+    diags = _run(tmp_path, DonationChecker, """\
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def go(a, steps):
+            for _ in range(steps):
+                a = step(a)
+                jax.block_until_ready(a)
+        """)
+    assert diags == []
+
+
+def test_donate_silent_on_state_chain(tmp_path):
+    # `state = step(state)` rebinding is how donation is SUPPOSED to
+    # be used — no stored alias, no finding
+    diags = _run(tmp_path, DonationChecker, """\
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def go(state, steps):
+            for _ in range(steps):
+                state = step(state)
+            return state
+        """)
+    assert diags == []
+
+
+def test_donate_marker_suppresses(tmp_path):
+    src = _DONATE_LOOP.replace(
+        "    jax.block_until_ready(ticket)",
+        "    # donation-safe: ticket is a fresh scalar reduction\n"
+        "    jax.block_until_ready(ticket)")
+    diags = _run(tmp_path, DonationChecker, src)
+    assert diags == []
+
+
+def test_donate_flags_stored_alias_reentry(tmp_path):
+    diags = _run(tmp_path, DonationChecker, """\
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def go(state, steps):
+            keep = None
+            for _ in range(steps):
+                state = step(state)
+                keep = state
+                out = step(keep)
+            return out
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 10
+    assert "donated position" in diags[0].message
+
+
+def test_donate_pallas_aliases_count_as_donating(tmp_path):
+    diags = _run(tmp_path, DonationChecker, """\
+        import jax
+        import jax.experimental.pallas as pl
+
+        kern = pl.pallas_call(lambda r: r, input_output_aliases={0: 0})
+
+        def go(a, b):
+            x = kern(a)
+            kern(b)
+            jax.block_until_ready(x)
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 9
+
+
+# -- WH-THREAD ---------------------------------------------------------------
+
+_THREAD_BASE = """\
+import threading
+
+SHARED_STATE = {{"Box": ("_items",)}}
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []{decl_comment}
+
+    def put(self, x):
+        {put_body}
+"""
+
+
+def test_thread_flags_unannotated_declaration(tmp_path):
+    src = _THREAD_BASE.format(
+        decl_comment="",
+        put_body="with self._lock:\n            self._items.append(x)")
+    diags = _run(tmp_path, ThreadChecker, src)
+    assert len(diags) == 1
+    assert diags[0].code == "WH-THREAD"
+    assert diags[0].line == 8
+    assert "declared without" in diags[0].message
+
+
+def test_thread_flags_unlocked_mutation(tmp_path):
+    src = _THREAD_BASE.format(
+        decl_comment="  # guarded-by: _lock",
+        put_body="self._items.append(x)")
+    diags = _run(tmp_path, ThreadChecker, src)
+    assert len(diags) == 1
+    assert diags[0].line == 11
+    assert "outside `with self._lock:`" in diags[0].message
+
+
+def test_thread_silent_on_locked_mutation(tmp_path):
+    src = _THREAD_BASE.format(
+        decl_comment="  # guarded-by: _lock",
+        put_body="with self._lock:\n            self._items.append(x)")
+    assert _run(tmp_path, ThreadChecker, src) == []
+
+
+def test_thread_flags_guardedby_with_no_such_lock(tmp_path):
+    diags = _run(tmp_path, ThreadChecker, """\
+        SHARED_STATE = {"Box": ("_items",)}
+
+        class Box:
+            def __init__(self):
+                self._items = []  # guarded-by: _lock
+        """)
+    assert len(diags) == 1
+    assert "no self._lock Lock/RLock/Condition" in diags[0].message
+
+
+def test_thread_owner_annotation_accepted_on_def_line(tmp_path):
+    diags = _run(tmp_path, ThreadChecker, """\
+        SHARED_STATE = {"Poller": ("count",)}
+
+        class Poller:
+            def __init__(self):
+                self.count = 0  # owner-thread: poller
+
+            def tick(self):  # owner-thread: poller
+                self.count += 1
+        """)
+    assert diags == []
+
+
+def test_thread_flags_unannotated_owner_mutation(tmp_path):
+    diags = _run(tmp_path, ThreadChecker, """\
+        SHARED_STATE = {"Poller": ("count",)}
+
+        class Poller:
+            def __init__(self):
+                self.count = 0  # owner-thread: poller
+
+            def tick(self):
+                self.count += 1
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 8
+    assert "owner-thread" in diags[0].message
+
+
+def test_thread_catches_embedded_mutator_call(tmp_path):
+    # `t = self._q.popleft()` mutates even though the call is buried
+    # in an Assign value, not a bare expression statement
+    diags = _run(tmp_path, ThreadChecker, """\
+        import threading
+
+        SHARED_STATE = {"Q": ("_q",)}
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def take(self):
+                t = self._q.pop()
+                return t
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 11
+
+
+def test_thread_no_mutation_cascade_when_declaration_bad(tmp_path):
+    # an unannotated declaration reports ONCE; its mutations are not
+    # also flagged (fix the declaration first)
+    src = _THREAD_BASE.format(decl_comment="",
+                              put_body="self._items.append(x)")
+    diags = _run(tmp_path, ThreadChecker, src)
+    assert len(diags) == 1
+    assert diags[0].line == 8
+
+
+# -- WH-HOSTSYNC -------------------------------------------------------------
+
+_HOT_BASE = """\
+import numpy as np
+import jax
+
+HOT_PATHS = ("hot", "Loop.step")
+
+def hot(xs):
+    out = []
+    for x in xs:
+        out.append({hot_expr})
+    return out
+
+def cold(xs):
+    return [np.asarray(x) for x in xs]
+
+class Loop:
+    def step(self, x):
+        return {method_expr}
+"""
+
+
+def test_hostsync_flags_materialize_in_hot_function(tmp_path):
+    src = _HOT_BASE.format(hot_expr="np.asarray(x)", method_expr="x")
+    diags = _run(tmp_path, HostSyncChecker, src)
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "WH-HOSTSYNC"
+    assert d.line == 9
+    assert "hot path hot" in d.message
+    # cold() materializes too but is not in HOT_PATHS — not flagged
+
+
+def test_hostsync_flags_method_and_not_marked_twice(tmp_path):
+    src = _HOT_BASE.format(hot_expr="x",
+                           method_expr="float(np.asarray(x))")
+    diags = _run(tmp_path, HostSyncChecker, src)
+    # float(np.asarray(...)) is ONE finding at the outer cast, not two
+    assert len(diags) == 1
+    assert diags[0].line == 17
+    assert "float(np.asarray(...)) readback" in diags[0].message
+
+
+def test_hostsync_marker_suppresses(tmp_path):
+    src = _HOT_BASE.format(
+        hot_expr="np.asarray(x)", method_expr="x").replace(
+        "        out.append(np.asarray(x))",
+        "        # host-sync: windowed readback, dispatched last tick\n"
+        "        out.append(np.asarray(x))")
+    assert _run(tmp_path, HostSyncChecker, src) == []
+
+
+def test_hostsync_flags_block_until_ready_and_item(tmp_path):
+    diags = _run(tmp_path, HostSyncChecker, """\
+        import jax
+
+        HOT_PATHS = ("hot",)
+
+        def hot(handles):
+            for h in handles:
+                jax.block_until_ready(h)
+                v = h.item()
+            return v
+        """)
+    assert [d.line for d in diags] == [7, 8]
+    kinds = [d.message for d in diags]
+    assert "block_until_ready" in kinds[0]
+    assert ".item()" in kinds[1]
+
+
+def test_hostsync_flags_device_bool_in_test(tmp_path):
+    diags = _run(tmp_path, HostSyncChecker, """\
+        import jax.numpy as jnp
+
+        HOT_PATHS = ("hot",)
+
+        def hot(x):
+            if jnp.any(x):
+                return 1
+            return 0
+        """)
+    assert len(diags) == 1
+    assert diags[0].line == 6
+    assert "implicit __bool__" in diags[0].message
+
+
+def test_hostsync_silent_off_hot_path(tmp_path):
+    diags = _run(tmp_path, HostSyncChecker, """\
+        import numpy as np
+
+        def anywhere(x):
+            return np.asarray(x).item()
+        """)
+    assert diags == []
+
+
+def test_hostsync_literal_args_not_materialization(tmp_path):
+    diags = _run(tmp_path, HostSyncChecker, """\
+        import numpy as np
+
+        HOT_PATHS = ("hot",)
+
+        def hot(n):
+            pad = np.asarray([0.0, 1.0])
+            z = np.zeros(4)
+            return pad, z
+        """)
+    assert diags == []
+
+
+# -- central tables point at real code ---------------------------------------
+
+def test_central_tables_resolve():
+    """Every path/class/attr in the repo-wide SHARED_STATE and
+    HOT_PATHS tables exists — a renamed class or file must update the
+    table, not silently skip the check."""
+    import ast
+    from wormhole_tpu.analysis.checkers.hostsync import HOT_PATHS
+    from wormhole_tpu.analysis.checkers.threads import SHARED_STATE
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel, classes in SHARED_STATE.items():
+        path = os.path.join(repo, rel)
+        assert os.path.isfile(path), rel
+        tree = ast.parse(open(path).read(), rel)
+        names = {n.name for n in ast.walk(tree)
+                 if isinstance(n, ast.ClassDef)}
+        for cls in classes:
+            assert cls in names, f"{rel}: class {cls} vanished"
+    for rel, dotted in HOT_PATHS.items():
+        path = os.path.join(repo, rel)
+        assert os.path.isfile(path), rel
+        src = open(path).read()
+        for name in dotted:
+            leaf = name.rsplit(".", 1)[-1]
+            assert f"def {leaf}" in src, f"{rel}: {name} vanished"
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

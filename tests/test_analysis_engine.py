@@ -1,0 +1,165 @@
+"""The shared analysis engine (wormhole_tpu/analysis/): one walk, one
+parse per file, lazy FileContext views, and the nine-checker registry
+the unified runner executes."""
+
+import os
+import textwrap
+
+import pytest
+
+from wormhole_tpu.analysis import engine as eng_mod
+from wormhole_tpu.analysis import (Diagnostic, Engine, FileContext,
+                                   find_marker, strip_comments)
+from wormhole_tpu.analysis.checkers import ALL_CHECKERS, BY_NAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path/wormhole_tpu."""
+    for rel, src in files.items():
+        p = tmp_path / "wormhole_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_has_nine_checkers():
+    assert len(ALL_CHECKERS) == 9
+    names = [c.name for c in ALL_CHECKERS]
+    assert names == ["scatters", "knobs", "collectives", "spans",
+                     "serve", "timeline", "donation", "threads",
+                     "hostsync"]
+    assert len({c.code for c in ALL_CHECKERS}) == 9
+    for cls in ALL_CHECKERS:
+        assert BY_NAME[cls.name] is cls
+        assert cls.code.startswith("WH-")
+
+
+# -- one parse per file ------------------------------------------------------
+
+def test_full_suite_parses_each_file_at_most_once(monkeypatch):
+    """The whole point of the engine: nine checkers, one ast.parse per
+    file. Probe the single choke point with a counting wrapper."""
+    counts = {}
+    real = eng_mod._parse_source
+
+    def probe(source, path):
+        counts[path] = counts.get(path, 0) + 1
+        return real(source, path)
+
+    monkeypatch.setattr(eng_mod, "_parse_source", probe)
+    checkers = [cls(REPO) for cls in ALL_CHECKERS]
+    for chk in checkers:
+        assert chk.precheck() is None
+    e = Engine(REPO, checkers)
+    e.run()
+    assert e.files_scanned > 20
+    assert counts, "suite never parsed anything?"
+    over = {p: n for p, n in counts.items() if n > 1}
+    assert not over, f"files parsed more than once: {over}"
+    # the engine's own accounting agrees with the probe
+    assert e.parses == sum(counts.values())
+
+
+def test_filecontext_views_are_lazy_and_cached(tmp_path):
+    root = _tree(tmp_path, {"m.py": "x = 1  # c\n"})
+    path = os.path.join(root, "wormhole_tpu", "m.py")
+    ctx = FileContext(root, path, "wormhole_tpu/m.py")
+    assert ctx.parse_count == 0
+    t1 = ctx.tree
+    t2 = ctx.tree
+    assert t1 is t2
+    assert ctx.parse_count == 1
+    assert ctx.code_lines == ["x = 1  "]
+    assert ctx.raw_lines == ["x = 1  # c"]
+
+
+def test_filecontext_syntax_error_yields_none(tmp_path):
+    root = _tree(tmp_path, {"bad.py": "def broken(:\n"})
+    path = os.path.join(root, "wormhole_tpu", "bad.py")
+    ctx = FileContext(root, path, "wormhole_tpu/bad.py")
+    assert ctx.tree is None
+    assert ctx.tree is None          # cached, not re-parsed
+    assert ctx.parse_count == 1
+
+
+# -- the walk ----------------------------------------------------------------
+
+def test_walk_skips_analysis_package():
+    e = Engine(REPO, [])
+    rels = [rel for _, rel in e.walk()]
+    assert rels, "walk found nothing"
+    assert not any(r.startswith("wormhole_tpu/analysis/") for r in rels)
+    assert all(r.endswith(".py") for r in rels)
+    # deterministic order: sorted within each directory level
+    assert "wormhole_tpu/obs/metrics.py" in rels
+
+
+def test_walk_only_wormhole_tpu(tmp_path):
+    root = _tree(tmp_path, {"a.py": "x = 1\n"})
+    (tmp_path / "elsewhere").mkdir()
+    (tmp_path / "elsewhere" / "b.py").write_text("y = 2\n")
+    rels = [rel for _, rel in Engine(root, []).walk()]
+    assert rels == ["wormhole_tpu/a.py"]
+
+
+# -- helpers -----------------------------------------------------------------
+
+def test_strip_comments_preserves_line_numbers():
+    src = "a = 1  # one\n# whole-line\nb = 2\n"
+    out = strip_comments(src)
+    assert out.splitlines() == ["a = 1  ", "", "b = 2"]
+
+
+def test_find_marker_window():
+    import re
+    pat = re.compile(r"#\s*host-sync:")
+    lines = ["x = 1",
+             "# host-sync: why",
+             "y = 2",
+             "z = 3",
+             "w = 4"]
+    assert find_marker(lines, 2, pat) is not None   # on the line
+    assert find_marker(lines, 3, pat) is not None   # 1 above
+    assert find_marker(lines, 4, pat) is not None   # 2 above
+    assert find_marker(lines, 5, pat) is None       # 3 above: outside
+
+
+def test_diagnostic_format():
+    assert Diagnostic("WH-X", "a/b.py", 7, "boom").format() \
+        == "WH-X a/b.py:7: boom"
+    assert Diagnostic("WH-X", "a/b.py", None, "boom").format() \
+        == "WH-X a/b.py: boom"
+
+
+def test_precheck_missing_package(tmp_path):
+    chk = ALL_CHECKERS[0](str(tmp_path))
+    err = chk.precheck()
+    assert err is not None and "no wormhole_tpu package" in err
+
+
+def test_engine_runs_all_visits_once_per_file(tmp_path):
+    root = _tree(tmp_path, {"a.py": "x = 1\n", "sub/b.py": "y = 2\n"})
+
+    seen = []
+
+    class Probe(eng_mod.Checker):
+        name = "probe"
+        code = "WH-PROBE"
+
+        def visit(self, ctx):
+            seen.append(ctx.rel)
+
+    e = Engine(root, [Probe(root), Probe(root)])
+    diags = e.run()
+    assert diags == []
+    assert e.files_scanned == 2
+    assert seen == ["wormhole_tpu/a.py", "wormhole_tpu/a.py",
+                    "wormhole_tpu/sub/b.py", "wormhole_tpu/sub/b.py"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

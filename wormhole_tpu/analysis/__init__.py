@@ -1,0 +1,29 @@
+"""Shared static-analysis engine for the repo's lint suite.
+
+One walk over ``wormhole_tpu/``, one comment-strip and at most one AST
+parse per file, shared by every checker. The checkers themselves live
+in :mod:`wormhole_tpu.analysis.checkers`; ``scripts/lint.py`` runs the
+whole registry in one process, and each legacy ``scripts/lint_*.py``
+is a thin shim over its migrated checker.
+
+Import-light on purpose (stdlib only, no jax): the lints must run on a
+bare CI box and on synthetic test trees.
+"""
+
+from wormhole_tpu.analysis.engine import (  # noqa: F401
+    Checker,
+    Diagnostic,
+    Engine,
+    FileContext,
+    find_marker,
+    strip_comments,
+)
+
+__all__ = [
+    "Checker",
+    "Diagnostic",
+    "Engine",
+    "FileContext",
+    "find_marker",
+    "strip_comments",
+]

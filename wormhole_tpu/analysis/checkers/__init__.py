@@ -1,0 +1,35 @@
+"""Checker registry: the nine analyses the unified runner executes.
+
+Order matters only for output stability; every checker consumes the
+same one-pass :class:`~wormhole_tpu.analysis.engine.FileContext`
+stream. The first six are the migrated legacy lints (their
+``scripts/lint_*.py`` shims re-export the module APIs from here); the
+last three are the new passes this framework was built for.
+"""
+
+from wormhole_tpu.analysis.checkers.scatters import ScatterChecker
+from wormhole_tpu.analysis.checkers.knobs import KnobChecker
+from wormhole_tpu.analysis.checkers.collectives import CollectiveChecker
+from wormhole_tpu.analysis.checkers.spans import SpanChecker
+from wormhole_tpu.analysis.checkers.serve import ServeChecker
+from wormhole_tpu.analysis.checkers.timeline import TimelineChecker
+from wormhole_tpu.analysis.checkers.donation import DonationChecker
+from wormhole_tpu.analysis.checkers.threads import ThreadChecker
+from wormhole_tpu.analysis.checkers.hostsync import HostSyncChecker
+
+ALL_CHECKERS = (
+    ScatterChecker,
+    KnobChecker,
+    CollectiveChecker,
+    SpanChecker,
+    ServeChecker,
+    TimelineChecker,
+    DonationChecker,
+    ThreadChecker,
+    HostSyncChecker,
+)
+
+BY_NAME = {cls.name: cls for cls in ALL_CHECKERS}
+
+__all__ = ["ALL_CHECKERS", "BY_NAME"] + [cls.__name__
+                                         for cls in ALL_CHECKERS]

@@ -1,0 +1,187 @@
+"""WH-COLLECTIVE: one transport layer, one routing-marker form.
+
+Migrated from ``scripts/lint_collectives.py`` (now a shim over this
+module). Rule 1: raw multihost transport lives only in
+``wormhole_tpu/parallel/transport.py`` — anything else must ride the
+transport stack (filters, wire-byte accounting, watchdog guard).
+Rule 2: every collective call site outside ``wormhole_tpu/parallel/``
+carries a single-form routing marker (engine/direct/mesh) within the
+preceding few lines, and the retired two-marker form is flagged.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from wormhole_tpu.analysis.engine import (Checker, Engine, FileContext,
+                                          strip_comments)
+
+# The single file allowed to touch the raw wire.
+TRANSPORT_HOME = "wormhole_tpu/parallel/transport.py"
+
+# Audited files outside TRANSPORT_HOME that legitimately reference the
+# raw multihost helpers. Deliberately EMPTY: the PR that unified the
+# transport rewrote every call site against the stack, and new entries
+# should be rare and argued.
+ALLOWLIST: dict = {}
+
+_PAT = re.compile(r"\bmultihost" + r"_utils\b")
+
+# rule 2: collective call sites and their routing markers
+_CALL_PAT = re.compile(
+    r"\b(allreduce_tree|allgather_tree|broadcast_tree)\s*\(")
+_MARKER_PAT = re.compile(r"#\s*transport:\s*(\w+)")
+_ROUTES = ("engine", "direct", "mesh")
+_MARKER_WINDOW = 3   # marker may sit up to this many lines above the call
+
+# the retired two-marker form; flagged so stale markers don't linger as
+# dead annotations that LOOK like routing decisions
+_OLD_MARKER_PAT = re.compile(r"#\s*(ps-engine|bsp-direct):")
+
+_strip_comments = strip_comments
+
+# fast whole-file gate: a file with none of these substrings cannot
+# produce a finding, so skip its per-line scans entirely
+_PRE = re.compile(r"multihost|allreduce_tree|allgather_tree|"
+                  r"broadcast_tree|ps-engine:|bsp-direct:")
+
+
+def _scan_code(code: str) -> list:
+    return [code.count("\n", 0, m.start()) + 1
+            for m in _PAT.finditer(code)]
+
+
+def _scan_marker_lines(raw_lines: list, code_lines: list) -> list:
+    out = []
+    for i, ln in enumerate(raw_lines):
+        if _OLD_MARKER_PAT.search(ln):
+            out.append((i + 1, "retired marker form (use `# transport: "
+                               "engine|direct|mesh`)"))
+    for i, ln in enumerate(code_lines):
+        m = _CALL_PAT.search(ln)
+        if m is None:
+            continue
+        lo = max(0, i - _MARKER_WINDOW)
+        marks = [_MARKER_PAT.search(r) for r in raw_lines[lo:i + 1]]
+        marks = [mk for mk in marks if mk is not None]
+        if not marks:
+            out.append((i + 1, f"{m.group(1)} without a `# transport:` "
+                               f"marker"))
+        elif not any(mk.group(1) in _ROUTES for mk in marks):
+            bad = ", ".join(sorted({mk.group(1) for mk in marks}))
+            out.append((i + 1, f"{m.group(1)} marker route {bad!r} not in "
+                               f"{'/'.join(_ROUTES)}"))
+    return out
+
+
+def scan_file(path: str) -> list:
+    """Return 1-based line numbers of raw multihost references."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return _scan_code(strip_comments(f.read()))
+
+
+def scan_markers(path: str) -> list:
+    """Rule 2: return ``(line, reason)`` for every collective call site
+    without a valid ``# transport: <route>`` marker on the call line or
+    the :data:`_MARKER_WINDOW` lines above it, plus every stale
+    old-form marker left in the file."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        raw = f.read()
+    return _scan_marker_lines(raw.splitlines(),
+                              strip_comments(raw).splitlines())
+
+
+class CollectiveChecker(Checker):
+    name = "collectives"
+    code = "WH-COLLECTIVE"
+
+    def __init__(self, root: str) -> None:
+        super().__init__(root)
+        self.violations: list = []   # "rel:line"
+        self.unmarked: list = []     # "rel:line: reason"
+        self.seen_allowed: set = set()
+
+    def visit(self, ctx: FileContext) -> None:
+        if ctx.rel == TRANSPORT_HOME:
+            return  # the one file that owns the raw wire
+        if _PRE.search(ctx.raw) is None:
+            return  # nothing scannable anywhere in the file
+        if not ctx.rel.startswith("wormhole_tpu/parallel/"):
+            for ln, why in _scan_marker_lines(ctx.raw_lines,
+                                              ctx.code_lines):
+                self.unmarked.append(f"{ctx.rel}:{ln}: {why}")
+                self.report(ctx.rel, ln,
+                            f"collective call site without a valid "
+                            f"routing marker: {why}")
+        lines = _scan_code(ctx.code)
+        if not lines:
+            return
+        if ctx.rel in ALLOWLIST:
+            self.seen_allowed.add(ctx.rel)
+        else:
+            for ln in lines:
+                self.violations.append(f"{ctx.rel}:{ln}")
+                self.report(ctx.rel, ln,
+                            f"raw multihost transport outside "
+                            f"{TRANSPORT_HOME}")
+
+    def finish(self) -> None:
+        for rel in sorted(set(ALLOWLIST) - self.seen_allowed):
+            self.warnings.append(
+                f"lint_collectives: allowlist entry {rel} has no raw "
+                f"multihost references (stale?)")
+
+    def ok_line(self) -> str:
+        return (f"{self.name}: OK ({len(self.seen_allowed)} "
+                f"allowlisted files)")
+
+    # -- legacy shim surface -------------------------------------------
+
+    def legacy_report(self, out=None, err=None) -> int:
+        out = out or sys.stdout
+        err = err or sys.stderr
+        for w in self.warnings:
+            print(w, file=err)
+        if self.violations:
+            print(f"lint_collectives: raw multihost transport outside "
+                  f"{TRANSPORT_HOME}:", file=err)
+            for v in self.violations:
+                print(f"  {v}", file=err)
+            print("route the call through the transport stack "
+                  "(parallel/collectives.py allreduce_tree / "
+                  "allgather_tree / broadcast_tree / "
+                  "host_local_to_global, or parallel/transport.py "
+                  "TransportStack) so it rides the layer stack and the "
+                  "comm byte counters, or add the file to ALLOWLIST in "
+                  "scripts/lint_collectives.py with a reason", file=err)
+            return 1
+        if self.unmarked:
+            print("lint_collectives: collective call sites without a "
+                  "valid routing marker:", file=err)
+            for v in self.unmarked:
+                print(f"  {v}", file=err)
+            print("mark the site `# transport: engine` (it runs on the "
+                  "exchange engine's drain thread — ExchangeEngine."
+                  "submit/exchange, e.g. via AsyncSGD._ctl), "
+                  "`# transport: direct` (it provably never coexists "
+                  "with a live engine) or `# transport: mesh` "
+                  "(host-side leg of the in-jit psum path) within "
+                  f"{_MARKER_WINDOW} lines above the call", file=err)
+            return 1
+        print(f"lint_collectives: OK ({len(self.seen_allowed)} "
+              f"allowlisted files)", file=out)
+        return 0
+
+
+def run(root: str) -> int:
+    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
+    pkg = os.path.join(root, "wormhole_tpu")
+    if not os.path.isdir(pkg):
+        print(f"lint_collectives: no wormhole_tpu package under "
+              f"{root!r}", file=sys.stderr)
+        return 2
+    chk = CollectiveChecker(root)
+    Engine(root, [chk]).run()
+    return chk.legacy_report()

@@ -1,0 +1,337 @@
+"""WH-DONATE: donated-buffer aliasing discipline (the PR 10 bug shape).
+
+``jax.jit(donate_argnums=...)`` invalidates the donated input buffers
+at dispatch; outputs may alias them. The bug class this catches: a
+returned value that aliases a donated input is STORED, the callable is
+dispatched again (re-donating the underlying buffer), and the stored
+value is then awaited (``block_until_ready``) or fed back in — on a
+committed multi-device layout the runtime raises "deleted or donated
+buffer", while a 1-CPU-device run silently masks it. Exactly the
+donated-ticket bug PR 10 fixed by hand in learners/store.py.
+
+Two shapes are flagged, per function scope:
+
+- **straight-line**: ``x = step(...)`` … another ``step(...)`` call …
+  ``block_until_ready(x)`` (or ``x`` passed back at a donated
+  position). The intervening dispatch may have re-donated the buffer
+  ``x`` aliases.
+- **loop-carried store**: ``x = step(...)`` inside a loop, ``ticket =
+  x`` stored in the same loop, and ``ticket`` awaited or re-entered
+  later — the next iteration's dispatch donates the buffer out from
+  under the stored alias.
+
+The await-before-next-dispatch idiom (``state, t = step(state); jax.
+block_until_ready(t)`` with no dispatch in between) is NOT flagged —
+that is the legal pattern. Sites that are safe by construction (the
+output provably never aliases a donated input, e.g. a fresh scalar
+reduction) carry a ``# donation-safe: <why>`` marker on the line or
+the two lines above.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from wormhole_tpu.analysis.engine import (Checker, FileContext,
+                                          find_marker, iter_stmts)
+
+MARKER = "donation-safe:"
+_MARKER_PAT = re.compile(r"#\s*donation-safe:")
+
+_JIT_NAMES = {"jit"}
+_AWAIT_NAME = "block_until_ready"
+
+
+def _attr_tail(func) -> str:
+    """Last dotted component of a call target (`a.b.c` -> 'c')."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _int_positions(node):
+    """Literal donate_argnums / alias-dict keys -> set of ints, or
+    None when the positions cannot be read statically."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def _donating_call_positions(call: ast.Call):
+    """(is_donating, positions) for a jax.jit / pl.pallas_call call."""
+    tail = _attr_tail(call.func)
+    if tail in _JIT_NAMES:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return True, _int_positions(kw.value)
+        return False, None
+    if tail == "pallas_call":
+        for kw in call.keywords:
+            if kw.arg == "input_output_aliases":
+                if isinstance(kw.value, ast.Dict):
+                    keys = set()
+                    for k in kw.value.keys:
+                        if not (isinstance(k, ast.Constant)
+                                and isinstance(k.value, int)):
+                            return True, None
+                        keys.add(k.value)
+                    return True, keys
+                return True, None
+        return False, None
+    return False, None
+
+
+def _collect_donating(nodes) -> dict:
+    """name -> donated positions (set | None=unknown) for every
+    donating callable declared in this module: decorated defs
+    (@partial(jax.jit, donate_argnums=...)), jit(...) assignments, and
+    pallas_call(..., input_output_aliases=...) assignments."""
+    out: dict = {}
+    for node in nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                if _attr_tail(dec.func) == "partial" and dec.args \
+                        and _attr_tail(dec.args[0]) in _JIT_NAMES:
+                    for kw in dec.keywords:
+                        if kw.arg == "donate_argnums":
+                            out[node.name] = _int_positions(kw.value)
+                else:
+                    donating, pos = _donating_call_positions(dec)
+                    if donating:
+                        out[node.name] = pos
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            donating, pos = _donating_call_positions(node.value)
+            if not donating:
+                # jit(f, donate_argnums=...) wrapped in partial(...)
+                inner = node.value
+                if _attr_tail(inner.func) == "partial" and inner.args \
+                        and isinstance(inner.args[0], ast.Call):
+                    donating, pos = _donating_call_positions(
+                        inner.args[0])
+            if donating:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = pos
+                    elif isinstance(t, ast.Attribute):
+                        out[t.attr] = pos
+    return out
+
+
+def _target_key(node):
+    """A trackable binding target: bare name or self-attribute."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+class _Taint:
+    __slots__ = ("callee", "bind_line", "in_loop", "stored")
+
+    def __init__(self, callee, bind_line, in_loop, stored):
+        self.callee = callee
+        self.bind_line = bind_line
+        self.in_loop = in_loop
+        self.stored = stored
+
+
+class _ScopeAnalyzer:
+    """Linear walk over one function body, loop-depth aware."""
+
+    def __init__(self, checker, ctx, donating, func):
+        self.checker = checker
+        self.ctx = ctx
+        self.donating = donating
+        self.func = func
+        self.taints: dict = {}          # key -> _Taint
+        self.call_lines: dict = {}      # callee -> [line, ...]
+        self.loop_depth = 0
+
+    def run(self) -> None:
+        for stmt in self.func.body:
+            self._stmt(stmt)
+
+    # -- statements ----------------------------------------------------
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self.loop_depth += 1
+            for s in stmt.body:
+                self._stmt(s)
+            self.loop_depth -= 1
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._expr_uses(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr_uses(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.Try,)):
+            for s in (stmt.body + sum([h.body for h in stmt.handlers],
+                                      []) + stmt.orelse
+                      + stmt.finalbody):
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr_uses(stmt.value)
+            self._bind(stmt.targets, stmt.value, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr_uses(stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr_uses(stmt.value)
+                self._bind([stmt.target], stmt.value, stmt.lineno)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs get their own scope pass from the checker
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.expr):
+                self._expr_uses(node, walk=False)
+
+    # -- bindings ------------------------------------------------------
+
+    def _bind(self, targets, value, lineno) -> None:
+        in_loop = self.loop_depth > 0
+        taint = None
+        if isinstance(value, ast.Call):
+            callee = _attr_tail(value.func)
+            if callee in self.donating:
+                taint = _Taint(callee, lineno, in_loop, stored=False)
+        elif isinstance(value, ast.Name) \
+                and value.id in self.taints:
+            src = self.taints[value.id]
+            # a plain-name copy is the "stored" alias that outlives
+            # the next dispatch
+            taint = _Taint(src.callee, src.bind_line,
+                           src.in_loop or in_loop, stored=True)
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                else [t]
+            for el in elts:
+                key = _target_key(el)
+                if key is None:
+                    continue
+                if taint is not None:
+                    self.taints[key] = taint
+                else:
+                    self.taints.pop(key, None)
+
+    # -- uses ----------------------------------------------------------
+
+    def _expr_uses(self, expr, walk=True) -> None:
+        nodes = ast.walk(expr) if walk else [expr]
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _attr_tail(node.func)
+            if tail == _AWAIT_NAME:
+                args = list(node.args)
+                if isinstance(node.func, ast.Attribute) and not args:
+                    args = [node.func.value]   # x.block_until_ready()
+                for a in args:
+                    key = _target_key(a)
+                    if key is not None:
+                        self._check_use(key, node.lineno, "awaited")
+            elif tail in self.donating:
+                pos = self.donating[tail]
+                for i, a in enumerate(node.args):
+                    key = _target_key(a)
+                    if key is not None and pos is not None and i in pos:
+                        self._check_reentry(key, node.lineno, tail)
+                self.call_lines.setdefault(tail, []).append(node.lineno)
+
+    def _redispatched(self, taint, use_line) -> bool:
+        """A lexical dispatch of the tainting callable strictly
+        between the bind and the use re-donates the buffer."""
+        return any(taint.bind_line < ln < use_line
+                   for ln in self.call_lines.get(taint.callee, ()))
+
+    def _check_use(self, key, line, how) -> None:
+        taint = self.taints.get(key)
+        if taint is None:
+            return
+        if self._redispatched(taint, line) \
+                or (taint.stored and taint.in_loop):
+            self.checker.flag(
+                self.ctx, line,
+                f"{key!r} (from donating call {taint.callee!r}, line "
+                f"{taint.bind_line}) {how} after {taint.callee!r} may "
+                f"have re-donated the buffer it aliases")
+
+    def _check_reentry(self, key, line, callee) -> None:
+        taint = self.taints.get(key)
+        if taint is None:
+            return
+        # the normal `state = step(state)` chain rebinding is legal;
+        # only a STORED alias re-entering a donated slot is the bug
+        if taint.stored and (taint.in_loop
+                             or self._redispatched(taint, line)):
+            self.checker.flag(
+                self.ctx, line,
+                f"stored alias {key!r} (from donating call "
+                f"{taint.callee!r}, line {taint.bind_line}) passed "
+                f"back to {callee!r} at a donated position")
+
+
+class DonationChecker(Checker):
+    name = "donation"
+    code = "WH-DONATE"
+
+    def visit(self, ctx: FileContext) -> None:
+        raw = ctx.raw
+        if "donate_argnums" not in raw \
+                and "input_output_aliases" not in raw:
+            return
+        tree = ctx.tree
+        if tree is None:
+            return
+        # statement-level sweep: donating declarations and function
+        # defs are statements, so skip the expression forest entirely
+        stmts = list(iter_stmts(tree.body))
+        donating = _collect_donating(stmts)
+        if not donating:
+            return
+        lines = ctx.raw_lines
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # lexical gate: a scope that never mentions a donating
+                # callee cannot bind a taint, so the linear pass over
+                # its statements would find nothing — skip it
+                body = lines[node.lineno - 1:node.end_lineno]
+                if any(name in ln for ln in body for name in donating):
+                    _ScopeAnalyzer(self, ctx, donating, node).run()
+
+    def flag(self, ctx: FileContext, line: int, message: str) -> None:
+        if find_marker(ctx.raw_lines, line, _MARKER_PAT, above=2):
+            return
+        self.report(ctx.rel, line,
+                    message + f" — await before the next dispatch, "
+                              f"return a fresh non-aliased value, or "
+                              f"mark `# {MARKER} <why>`")

@@ -1,0 +1,173 @@
+"""WH-KNOB: every Config knob documented; every metric name unique.
+
+Migrated from ``scripts/lint_knobs.py`` (now a shim over this module).
+Rule 1: every annotated field of ``wormhole_tpu.utils.config.Config``
+appears under ``docs/*.md`` (extracted by AST, no jax import). Rule 2:
+every literal metric name declared against a registry is declared at
+exactly one site — two sites silently merge their streams.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+import sys
+
+from wormhole_tpu.analysis.engine import Checker, Engine, FileContext
+
+# Config fields that may legitimately stay out of docs/. Every entry
+# carries a reason; keep this empty-by-default bias — documenting the
+# knob is almost always cheaper than explaining why not.
+KNOB_ALLOWLIST = {}
+
+# literal metric declaration sites the uniqueness rule applies to;
+# computed names (`prefix + k`) are adapter plumbing, not declarations.
+_METRIC_PAT = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*['\"]([^'\"]+)['\"]")
+
+_CONFIG_REL = "wormhole_tpu/utils/config.py"
+
+
+def _fields_from_tree(tree, path: str) -> list:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return [st.target.id for st in node.body
+                    if isinstance(st, ast.AnnAssign)
+                    and isinstance(st.target, ast.Name)]
+    raise RuntimeError(f"no Config class found in {path}")
+
+
+def config_fields(root: str) -> list:
+    """Config's annotated field names, by AST (import-free)."""
+    path = os.path.join(root, "wormhole_tpu", "utils", "config.py")
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), path)
+    return _fields_from_tree(tree, path)
+
+
+def documented_text(root: str) -> str:
+    parts = []
+    for p in sorted(glob.glob(os.path.join(root, "docs", "*.md"))):
+        with open(p, "r", encoding="utf-8", errors="replace") as f:
+            parts.append(f.read())
+    return "\n".join(parts)
+
+
+def _missing_knobs(fields: list, docs: str) -> list:
+    # word-boundary match: the name in prose, a table row, or a
+    # `key=value` example all count; substrings of other words don't.
+    # Field names are identifiers (\w+), so one tokenization of the
+    # docs is equivalent to a \b<name>\b search per field.
+    words = set(re.findall(r"\w+", docs))
+    return [name for name in fields
+            if name not in KNOB_ALLOWLIST and name not in words]
+
+
+def undocumented_knobs(root: str) -> list:
+    return _missing_knobs(config_fields(root), documented_text(root))
+
+
+def metric_sites(root: str) -> dict:
+    """name -> ["file:line", ...] of literal metric declarations."""
+    chk = KnobChecker(root)
+    Engine(root, [chk]).run()
+    return chk.sites
+
+
+def duplicate_metrics(root: str) -> dict:
+    return {name: where for name, where in metric_sites(root).items()
+            if len(where) > 1}
+
+
+class KnobChecker(Checker):
+    name = "knobs"
+    code = "WH-KNOB"
+
+    def __init__(self, root: str) -> None:
+        super().__init__(root)
+        self.sites: dict = {}          # metric name -> ["rel:line"]
+        self.fields: list = None       # Config fields, once visited
+        self.missing: list = []
+        self.dups: dict = {}
+
+    def visit(self, ctx: FileContext) -> None:
+        raw = ctx.raw
+        # substring pre-gate: the declaration pattern can only match
+        # where one of these literals appears, and `in` beats finditer
+        if ".counter" in raw or ".gauge" in raw or ".histogram" in raw:
+            for m in _METRIC_PAT.finditer(raw):
+                ln = raw.count("\n", 0, m.start()) + 1
+                self.sites.setdefault(m.group(2), []).append(
+                    f"{ctx.rel}:{ln}")
+        if ctx.rel == _CONFIG_REL:
+            tree = ctx.tree
+            if tree is not None:
+                self.fields = _fields_from_tree(tree, ctx.path)
+
+    def finish(self) -> None:
+        if self.fields is None:
+            # legacy behavior: a missing/unparsable Config is a hard
+            # error, not a silent pass
+            path = os.path.join(self.root, "wormhole_tpu", "utils",
+                                "config.py")
+            with open(path, "r", encoding="utf-8") as f:
+                self.fields = _fields_from_tree(
+                    ast.parse(f.read(), path), path)
+        self.missing = _missing_knobs(self.fields,
+                                      documented_text(self.root))
+        for name in self.missing:
+            self.report(_CONFIG_REL, None,
+                        f"Config field {name!r} missing from docs/*.md")
+        self.dups = {name: where for name, where in self.sites.items()
+                     if len(where) > 1}
+        for name, where in sorted(self.dups.items()):
+            self.report(where[0].rsplit(":", 1)[0],
+                        int(where[0].rsplit(":", 1)[1]),
+                        f"metric {name!r} declared at multiple sites: "
+                        f"{', '.join(where)}")
+
+    def ok_line(self) -> str:
+        return (f"{self.name}: OK ({len(self.fields or [])} knobs "
+                f"documented, {len(self.sites)} unique metric names)")
+
+    # -- legacy shim surface -------------------------------------------
+
+    def legacy_report(self, out=None, err=None) -> int:
+        out = out or sys.stdout
+        err = err or sys.stderr
+        rc = 0
+        if self.missing:
+            rc = 1
+            print("lint_knobs: Config fields missing from docs/*.md:",
+                  file=err)
+            for name in self.missing:
+                print(f"  {name}", file=err)
+            print("add a row to docs/config.md (or, with a reason, to "
+                  "KNOB_ALLOWLIST in scripts/lint_knobs.py)", file=err)
+        if self.dups:
+            rc = 1
+            print("lint_knobs: metric names declared at multiple "
+                  "sites:", file=err)
+            for name, where in sorted(self.dups.items()):
+                print(f"  {name}: {', '.join(where)}", file=err)
+            print("declare each metric once and pass the object around "
+                  "(two declaration sites silently merge their "
+                  "streams)", file=err)
+        if rc == 0:
+            print(f"lint_knobs: OK ({len(self.fields)} knobs "
+                  f"documented, {len(self.sites)} unique metric names)",
+                  file=out)
+        return rc
+
+
+def run(root: str) -> int:
+    """Run both rules; return a process rc."""
+    if not os.path.isdir(os.path.join(root, "wormhole_tpu")):
+        print(f"lint_knobs: no wormhole_tpu package under {root!r}",
+              file=sys.stderr)
+        return 2
+    chk = KnobChecker(root)
+    Engine(root, [chk]).run()
+    return chk.legacy_report()

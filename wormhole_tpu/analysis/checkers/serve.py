@@ -1,0 +1,121 @@
+"""WH-SERVE: nothing under wormhole_tpu/serve/ touches training entry
+points.
+
+Migrated from ``scripts/lint_serve.py`` (now a shim over this module).
+The serving tier is PULL-ONLY: it reads model snapshots and computes
+margins; it never updates parameters, never touches optimizer state,
+never scatters into a table — a serve-side write would race the
+training loop and tear the swap's one-consistent-model guarantee.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from wormhole_tpu.analysis.engine import (Checker, Engine, FileContext,
+                                          strip_comments)
+
+# The training mutation surface, as call-site patterns. Textual on
+# purpose (same rationale as the scatter checker): it must catch the
+# names inside strings being exec'd or built dynamically too, and a
+# false positive in serve/ code is itself a smell worth renaming away.
+FORBIDDEN = [
+    # fused/tile/dense training steps
+    (re.compile(r"\btrain_step\b"), "training step dispatch"),
+    # delay-tolerant split pipeline (both halves are training-only)
+    (re.compile(r"\bdt2_push\b"), "DT2 delayed push"),
+    (re.compile(r"\bdt2_pull\b"), "DT2 gradient pull (training half)"),
+    # handle/optimizer update entry points
+    (re.compile(r"\.push\s*\("), "parameter push (optimizer update)"),
+    (re.compile(r"\bmasked_push\b"), "masked parameter push"),
+    (re.compile(r"\bbackward_grad\b"), "gradient computation for push"),
+    (re.compile(r"\bbackward_pushes\b"), "tile backward push pipeline"),
+    # raw scatter-add into a table (the push primitive itself)
+    (re.compile(r"\.at\s*\[[^\]]*\]\s*\.add\s*\(", re.S),
+     "scatter-add into a parameter table"),
+    # restoring state INTO the training store from serve code would be
+    # a write to the trainer's model; serve loads into its own standby
+    (re.compile(r"\brestore_pytree\b"), "training-store state restore"),
+]
+
+_strip_comments = strip_comments
+
+_SCOPE = "wormhole_tpu/serve/"
+
+
+def _scan_text(code: str) -> list:
+    out = []
+    for pat, reason in FORBIDDEN:
+        out.extend((code.count("\n", 0, m.start()) + 1, reason)
+                   for m in pat.finditer(code))
+    return sorted(out)
+
+
+def scan_file(path: str) -> list:
+    """Return ``(line, reason)`` violations in ``path``."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return _scan_text(strip_comments(f.read()))
+
+
+class ServeChecker(Checker):
+    name = "serve"
+    code = "WH-SERVE"
+
+    def __init__(self, root: str) -> None:
+        super().__init__(root)
+        self.violations: list = []   # "rel:line: reason"
+        self.nfiles = 0
+
+    def precheck(self):
+        if not os.path.isdir(os.path.join(self.root, "wormhole_tpu",
+                                          "serve")):
+            return (f"lint_serve: no wormhole_tpu/serve package under "
+                    f"{self.root!r}")
+        return None
+
+    def visit(self, ctx: FileContext) -> None:
+        if not ctx.rel.startswith(_SCOPE):
+            return
+        self.nfiles += 1
+        for ln, reason in _scan_text(ctx.code):
+            self.violations.append(f"{ctx.rel}:{ln}: {reason}")
+            self.report(ctx.rel, ln,
+                        f"serve/ is pull-only but reaches a training "
+                        f"mutation entry point: {reason}")
+
+    def ok_line(self) -> str:
+        return f"{self.name}: OK ({self.nfiles} serve files pull-only)"
+
+    # -- legacy shim surface -------------------------------------------
+
+    def legacy_report(self, out=None, err=None) -> int:
+        out = out or sys.stdout
+        err = err or sys.stderr
+        if self.violations:
+            print("lint_serve: serving code reaching a training "
+                  "mutation entry point (serve/ is pull-only):",
+                  file=err)
+            for v in self.violations:
+                print(f"  {v}", file=err)
+            print("serving must never push/update/scatter — if the "
+                  "feature needs writes, it belongs in learners/ "
+                  "behind the store API, not under wormhole_tpu/serve/",
+                  file=err)
+            return 1
+        print(f"lint_serve: OK ({self.nfiles} serve files pull-only)",
+              file=out)
+        return 0
+
+
+def run(root: str) -> int:
+    """Scan ``root``/wormhole_tpu/serve for violations; return an rc."""
+    pkg = os.path.join(root, "wormhole_tpu", "serve")
+    if not os.path.isdir(pkg):
+        print(f"lint_serve: no wormhole_tpu/serve package under {root!r}",
+              file=sys.stderr)
+        return 2
+    chk = ServeChecker(root)
+    Engine(root, [chk]).run()
+    return chk.legacy_report()

@@ -1,0 +1,317 @@
+"""WH-THREAD: lock discipline for shared mutable state.
+
+The repo's daemon-thread population (ps drain, timeline sampler,
+heartbeat monitor, snapshot poller, watchdog, supervisor, feed
+dispatcher) mutates object state that other threads read. This pass
+makes the discipline machine-checked: every attribute named in the
+:data:`SHARED_STATE` table must be DECLARED with its discipline at its
+``__init__`` assignment — ``# guarded-by: <lockattr>`` (a Lock/RLock/
+Condition assigned in the same ``__init__``) or ``# owner-thread:
+<label>`` (single-writer) — and every mutation outside ``__init__``
+must either sit lexically inside ``with self.<lockattr>:`` or carry a
+matching site/def-line annotation (``# guarded-by: <lockattr>`` as a
+caller-holds-the-lock claim, ``# owner-thread: <label>`` naming the
+writer).
+
+A scanned module may also declare its own table with a module-level
+``SHARED_STATE = {"ClassName": ("attr", ...)}`` assignment — that is
+how fixture trees (and future out-of-tree code) opt in.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from wormhole_tpu.analysis.engine import (Checker, FileContext,
+                                          iter_stmts)
+
+# rel path -> {ClassName: (shared attrs...)} — the repo's audited
+# shared-state surface. Every attr here is read or written by more
+# than one thread (or handed between threads) somewhere in the system.
+SHARED_STATE = {
+    # delta tickets deque: trainer-only by design (the drain thread
+    # sees tickets through WindowQueue, never through this deque)
+    "wormhole_tpu/ps/engine.py": {
+        "ExchangeEngine": ("_pending",),
+    },
+    # hot-swap params: written by the poller's swap, read per-batch
+    "wormhole_tpu/serve/forward.py": {
+        "ForwardStep": ("_params",),
+    },
+    # poller bookkeeping: single-writer on the serve-snapshot thread
+    "wormhole_tpu/serve/snapshot.py": {
+        "SnapshotPoller": ("version", "swaps"),
+    },
+    # admission/flush counters: flush thread writes, stats() reads
+    "wormhole_tpu/serve/frontend.py": {
+        "ServeFrontend": ("_requests", "_batches", "_deadline_flushes",
+                          "_full_flushes", "_depth_max", "_lat"),
+    },
+    # work queue shared by every claimant rank's scheduler calls
+    "wormhole_tpu/sched/workload_pool.py": {
+        "WorkloadPool": ("_queue", "_assigned", "_done_ids",
+                         "_durations"),
+    },
+    # metric registry: inc'd from drain/sampler/frontend threads,
+    # merged from the learner thread
+    "wormhole_tpu/obs/metrics.py": {
+        "Registry": ("_metrics",),
+    },
+    # sampler ring (reader: summarize/SLO) + sampler-owned cursors
+    "wormhole_tpu/obs/timeline.py": {
+        "TimelineSampler": ("_ring", "_prev", "_prev_mono", "_seq"),
+    },
+    # feed stage stats: dispatcher/worker/transfer threads + stats()
+    "wormhole_tpu/data/pipeline.py": {
+        "DeviceFeed": ("_busy", "_stall", "_batches", "_ring_max"),
+    },
+}
+
+_GUARDED_PAT = re.compile(r"#\s*guarded-by:\s*(\w+)")
+_OWNER_PAT = re.compile(r"#\s*owner-thread:\s*([\w-]+)")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATORS = {"append", "appendleft", "extend", "pop", "popleft",
+             "popitem", "clear", "update", "add", "remove", "discard",
+             "insert", "setdefault", "sort", "reverse", "rotate"}
+
+_DECL_WINDOW = 2   # annotation on the line or up to 2 lines above
+
+
+def _self_attr(node) -> str:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _inline_table(tree) -> dict:
+    """A module-level SHARED_STATE = {"Class": ("attr", ...)} literal."""
+    out: dict = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "SHARED_STATE"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            attrs = []
+            if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                attrs = [el.value for el in v.elts
+                         if isinstance(el, ast.Constant)
+                         and isinstance(el.value, str)]
+            out[k.value] = tuple(attrs)
+    return out
+
+
+def _marker_near(raw_lines, line, pat, above=_DECL_WINDOW):
+    lo = max(0, line - 1 - above)
+    for raw in raw_lines[lo:line]:
+        m = pat.search(raw)
+        if m is not None:
+            return m.group(1)
+    return None
+
+
+class _Discipline:
+    __slots__ = ("kind", "arg")   # kind: "guarded-by" | "owner-thread"
+
+    def __init__(self, kind, arg):
+        self.kind = kind
+        self.arg = arg
+
+
+class ThreadChecker(Checker):
+    name = "threads"
+    code = "WH-THREAD"
+
+    def visit(self, ctx: FileContext) -> None:
+        table = dict(SHARED_STATE.get(ctx.rel, {}))
+        if "SHARED_STATE" not in ctx.raw and not table:
+            return
+        tree = ctx.tree
+        if tree is None:
+            return
+        if "SHARED_STATE" in ctx.raw:
+            table.update(_inline_table(tree))
+        if not table:
+            return
+        for node in iter_stmts(tree.body):
+            if isinstance(node, ast.ClassDef) and node.name in table:
+                self._check_class(ctx, node, table[node.name])
+
+    # -- per class -----------------------------------------------------
+
+    def _check_class(self, ctx, cls, attrs) -> None:
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        locks = self._lock_attrs(init) if init is not None else set()
+        disciplines = {}
+        for attr in attrs:
+            disciplines[attr] = self._declaration(ctx, cls, init,
+                                                  attr, locks)
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name != "__init__":
+                self._check_method(ctx, cls, node, disciplines, locks)
+
+    def _lock_attrs(self, init) -> set:
+        locks = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ctor = node.value.func
+                tail = (ctor.attr if isinstance(ctor, ast.Attribute)
+                        else ctor.id if isinstance(ctor, ast.Name)
+                        else "")
+                if tail in _LOCK_CTORS:
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a:
+                            locks.add(a)
+        return locks
+
+    def _declaration(self, ctx, cls, init, attr, locks):
+        """Find `self.<attr> = ...` in __init__ and read its
+        discipline annotation."""
+        if init is None:
+            self.report(ctx.rel, cls.lineno,
+                        f"shared attr {cls.name}.{attr} has no "
+                        f"__init__ declaration site to annotate")
+            return None
+        site = None
+        for node in ast.walk(init):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            if any(_self_attr(t) == attr for t in targets):
+                site = node.lineno
+                break
+        if site is None:
+            self.report(ctx.rel, init.lineno,
+                        f"shared attr {cls.name}.{attr} is never "
+                        f"assigned in __init__")
+            return None
+        lock = _marker_near(ctx.raw_lines, site, _GUARDED_PAT)
+        if lock is not None:
+            if lock not in locks:
+                self.report(ctx.rel, site,
+                            f"{cls.name}.{attr} guarded-by {lock!r} "
+                            f"but no self.{lock} Lock/RLock/Condition "
+                            f"is assigned in __init__")
+                return None
+            return _Discipline("guarded-by", lock)
+        owner = _marker_near(ctx.raw_lines, site, _OWNER_PAT)
+        if owner is not None:
+            return _Discipline("owner-thread", owner)
+        self.report(ctx.rel, site,
+                    f"shared attr {cls.name}.{attr} declared without "
+                    f"a `# guarded-by: <lock>` or `# owner-thread: "
+                    f"<label>` annotation")
+        return None
+
+    # -- per method ----------------------------------------------------
+
+    def _check_method(self, ctx, cls, method, disciplines, locks):
+        # lexical gate: every mutation form this pass recognizes
+        # (assign/augassign target, subscript store, mutator method
+        # call) spells `self.<attr>` somewhere in the method text —
+        # a method that never does cannot produce a finding
+        body = ctx.raw_lines[method.lineno - 1:method.end_lineno]
+        probes = tuple("self." + a for a in disciplines)
+        if not any(p in ln for ln in body for p in probes):
+            return
+
+        def walk(stmt, held):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in stmt.items:
+                    a = _self_attr(item.context_expr)
+                    if a in locks:
+                        inner.add(a)
+                for s in stmt.body:
+                    walk(s, inner)
+                return
+            self._mutations(ctx, cls, method, stmt, disciplines, held)
+            for s in ast.iter_child_nodes(stmt):
+                if isinstance(s, ast.stmt):
+                    walk(s, held)
+
+        for stmt in method.body:
+            walk(stmt, set())
+
+    def _mutations(self, ctx, cls, method, stmt, disciplines, held):
+        muts = []   # (attr, line)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for el in elts:
+                    a = _self_attr(el)
+                    if not a and isinstance(el, ast.Subscript):
+                        a = _self_attr(el.value)
+                    if a in disciplines:
+                        muts.append((a, stmt.lineno))
+        # mutating method calls anywhere in this statement's
+        # expressions (self.q.append(x), t = self.q.popleft(), ...)
+        for part in ast.iter_child_nodes(stmt):
+            if not isinstance(part, ast.expr):
+                continue
+            for node in ast.walk(part):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    a = _self_attr(node.func.value)
+                    if a in disciplines:
+                        muts.append((a, node.lineno))
+        for attr, line in muts:
+            self._check_mutation(ctx, cls, method, attr, line,
+                                 disciplines[attr], held)
+
+    def _check_mutation(self, ctx, cls, method, attr, line, disc,
+                        held):
+        if disc is None:
+            return   # declaration already flagged; avoid a cascade
+        if disc.kind == "guarded-by":
+            if disc.arg in held:
+                return
+            claimed = (_marker_near(ctx.raw_lines, line, _GUARDED_PAT)
+                       or _marker_near(ctx.raw_lines, method.lineno,
+                                       _GUARDED_PAT, above=0))
+            if claimed == disc.arg:
+                return   # caller-holds-the-lock claim, audited
+            self.report(ctx.rel, line,
+                        f"mutation of {cls.name}.{attr} outside `with "
+                        f"self.{disc.arg}:` (declared guarded-by: "
+                        f"{disc.arg}; annotate the site or def line "
+                        f"`# guarded-by: {disc.arg}` if the caller "
+                        f"holds it)")
+        else:
+            owner = (_marker_near(ctx.raw_lines, line, _OWNER_PAT)
+                     or _marker_near(ctx.raw_lines, method.lineno,
+                                     _OWNER_PAT, above=0))
+            if owner == disc.arg:
+                return
+            if owner is not None:
+                self.report(ctx.rel, line,
+                            f"mutation of {cls.name}.{attr} annotated "
+                            f"owner-thread {owner!r} but the attr is "
+                            f"declared owner-thread {disc.arg!r}")
+            else:
+                self.report(ctx.rel, line,
+                            f"mutation of {cls.name}.{attr} without "
+                            f"an `# owner-thread: {disc.arg}` "
+                            f"annotation (declared single-writer on "
+                            f"{disc.arg!r})")

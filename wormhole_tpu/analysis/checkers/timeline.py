@@ -1,0 +1,270 @@
+"""WH-TIMELINE: every timeline series declared once in SERIES_TABLE.
+
+Migrated from ``scripts/lint_timeline.py`` (now a shim over this
+module). The timeline plane emits per-sample series that the SLO
+tracker and summarizers read back by name; a renamed series fails
+silently (the burn rate just stays 0). Rules: SERIES_TABLE declared
+exactly once with no duplicate keys; every literal ``Objective``
+series resolves (table entry, registry metric, or ``*suffix`` derived
+rule); every derived-suffix emission and ``record(...)`` field the
+sampler stamps is declared.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+from wormhole_tpu.analysis.engine import Checker, Engine, FileContext
+
+# registry metric declaration sites (the knob-checker contract)
+_METRIC_PAT = re.compile(
+    r"\.(?:counter|gauge|histogram)" + r"\(\s*['\"]([^'\"]+)['\"]")
+# literal derived-suffix concatenations in the sampler
+_SUFFIX_PAT = re.compile(r"\+\s*['\"](_[a-z0-9]+)['\"]")
+
+_TABLE_NAME = "SERIES_TABLE"
+_SAMPLER_REL = "wormhole_tpu/obs/timeline.py"
+
+
+def _table_assigns(nodes, rel: str):
+    for node in nodes:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == _TABLE_NAME
+                   for t in targets):
+            continue
+        keys, dups = [], []
+        val = node.value
+        if isinstance(val, ast.Dict):
+            seen = set()
+            for k in val.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    if k.value in seen:
+                        dups.append(k.value)
+                    seen.add(k.value)
+                    keys.append(k.value)
+        yield f"{rel}:{node.lineno}", keys, dups
+
+
+def _objectives_in_tree(nodes, rel: str, sites: dict) -> None:
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fname = (node.func.id if isinstance(node.func, ast.Name)
+                 else node.func.attr
+                 if isinstance(node.func, ast.Attribute) else "")
+        if fname != "Objective":
+            continue
+        series = None
+        if len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            series = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "series" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                series = kw.value.value
+        if series is not None:
+            sites.setdefault(series, []).append(f"{rel}:{node.lineno}")
+
+
+def _record_fields_in_tree(nodes, rel: str, sites: dict) -> None:
+    for node in nodes:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "record":
+            for kw in node.keywords:
+                if kw.arg:
+                    sites.setdefault(kw.arg, []).append(
+                        f"{rel}:{node.lineno}")
+            for stamp in ("ts", "mono"):   # Registry.record stamps
+                sites.setdefault(stamp, []).append(
+                    f"{rel}:{node.lineno}")
+
+
+def series_table(root: str):
+    """(keys, duplicate_keys, declaration_sites) of SERIES_TABLE by
+    AST walk (import-free, works on synthetic trees)."""
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.keys, chk.dups, chk.decl_sites
+
+
+def metric_names(root: str) -> set:
+    """Every literal registry metric name declared under
+    wormhole_tpu/ (counter/gauge/histogram call sites)."""
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.metrics
+
+
+def objective_series(root: str) -> dict:
+    """series-name -> ["file:line", ...] for every literal series
+    handed to an Objective(...) construction."""
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.objectives
+
+
+def derived_suffixes(root: str) -> dict:
+    """suffix -> ["file:line", ...] of literal `+ "_suffix"` series
+    emissions in the sampler module."""
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.suffixes
+
+
+def record_fields(root: str) -> dict:
+    """field -> ["file:line", ...] of keywords the sampler stamps via
+    Registry.record(...), plus the ts/mono stamps record itself adds."""
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.rec_fields
+
+
+def _resolves(series: str, keys: list, metrics: set) -> bool:
+    """A series resolves through an exact table entry, a registry
+    metric name, or a declared `*suffix` rule over a registry metric
+    (p50/p99/rate series derived by the sampler)."""
+    if series in keys or series in metrics:
+        return True
+    for k in keys:
+        if k.startswith("*") and series.endswith(k[1:]):
+            stem = series[:-len(k[1:])]
+            if stem in metrics or stem in keys:
+                return True
+    return False
+
+
+class TimelineChecker(Checker):
+    name = "timeline"
+    code = "WH-TIMELINE"
+
+    def __init__(self, root: str) -> None:
+        super().__init__(root)
+        self.keys: list = []
+        self.dups: list = []
+        self.decl_sites: list = []
+        self.metrics: set = set()
+        self.objectives: dict = {}
+        self.suffixes: dict = {}
+        self.rec_fields: dict = {}
+        self.checked = 0
+
+    def visit(self, ctx: FileContext) -> None:
+        raw = ctx.raw
+        # substring pre-gate before the regex: most files declare no
+        # metrics at all, and `in` is far cheaper than finditer
+        if ".counter" in raw or ".gauge" in raw or ".histogram" in raw:
+            self.metrics.update(_METRIC_PAT.findall(raw))
+        if ctx.rel == _SAMPLER_REL:
+            for m in _SUFFIX_PAT.finditer(ctx.raw):
+                ln = ctx.raw.count("\n", 0, m.start()) + 1
+                self.suffixes.setdefault(m.group(1), []).append(
+                    f"{ctx.rel}:{ln}")
+        # cheap gates before the shared parse: only files that can
+        # contribute table entries, objectives or record fields
+        if _TABLE_NAME not in ctx.raw and "Objective" not in ctx.raw \
+                and ctx.rel != _SAMPLER_REL:
+            return
+        nodes = ctx.nodes              # one shared walk, reused below
+        if not nodes:
+            return
+        for site, keys, dups in _table_assigns(nodes, ctx.rel):
+            self.decl_sites.append(site)
+            self.keys.extend(keys)
+            self.dups.extend(dups)
+        _objectives_in_tree(nodes, ctx.rel, self.objectives)
+        if ctx.rel == _SAMPLER_REL:
+            _record_fields_in_tree(nodes, ctx.rel, self.rec_fields)
+
+    def finish(self) -> None:
+        if len(self.decl_sites) != 1:
+            self.report(_SAMPLER_REL, None,
+                        f"SERIES_TABLE declared at "
+                        f"{len(self.decl_sites)} sites (want exactly "
+                        f"1): {', '.join(self.decl_sites) or 'none'}")
+        for k in self.dups:
+            self.report(_SAMPLER_REL, None,
+                        f"duplicate SERIES_TABLE key {k!r}")
+        for label, sites in (("objective series", self.objectives),
+                             ("record field", self.rec_fields)):
+            for name, where in sorted(sites.items()):
+                self.checked += 1
+                ok = (_resolves(name, self.keys, self.metrics)
+                      if label != "record field" else name in self.keys)
+                if not ok:
+                    rel, ln = where[0].rsplit(":", 1)
+                    self.report(rel, int(ln),
+                                f"{label} {name!r} does not resolve "
+                                f"through SERIES_TABLE "
+                                f"({', '.join(where)})")
+        for suffix, where in sorted(self.suffixes.items()):
+            self.checked += 1
+            if "*" + suffix not in self.keys:
+                rel, ln = where[0].rsplit(":", 1)
+                self.report(rel, int(ln),
+                            f"derived suffix {suffix!r} emitted "
+                            f"without a '*{suffix}' SERIES_TABLE entry "
+                            f"({', '.join(where)})")
+
+    def ok_line(self) -> str:
+        return (f"{self.name}: OK ({self.checked} series sites resolve "
+                f"through {len(self.keys)} table entries)")
+
+    # -- legacy shim surface -------------------------------------------
+
+    def legacy_report(self, out=None, err=None) -> int:
+        out = out or sys.stdout
+        err = err or sys.stderr
+        rc = 0
+        if len(self.decl_sites) != 1:
+            rc = 1
+            print(f"lint_timeline: SERIES_TABLE declared at "
+                  f"{len(self.decl_sites)} sites (want exactly 1): "
+                  f"{', '.join(self.decl_sites) or 'none'}", file=err)
+        if self.dups:
+            rc = 1
+            print("lint_timeline: duplicate SERIES_TABLE keys (the "
+                  "dict literal silently keeps the last):", file=err)
+            for k in self.dups:
+                print(f"  {k}", file=err)
+        for label, sites in (("objective series", self.objectives),
+                             ("record field", self.rec_fields)):
+            for name, where in sorted(sites.items()):
+                ok = (_resolves(name, self.keys, self.metrics)
+                      if label != "record field" else name in self.keys)
+                if not ok:
+                    rc = 1
+                    print(f"lint_timeline: {label} {name!r} does not "
+                          f"resolve through SERIES_TABLE "
+                          f"({', '.join(where)})", file=err)
+        for suffix, where in sorted(self.suffixes.items()):
+            if "*" + suffix not in self.keys:
+                rc = 1
+                print(f"lint_timeline: derived suffix {suffix!r} "
+                      f"emitted without a '*{suffix}' SERIES_TABLE "
+                      f"entry ({', '.join(where)})", file=err)
+        if rc == 0:
+            print(f"lint_timeline: OK ({self.checked} series sites "
+                  f"resolve through {len(self.keys)} table entries)",
+                  file=out)
+        return rc
+
+
+def run(root: str) -> int:
+    if not os.path.isdir(os.path.join(root, "wormhole_tpu")):
+        print(f"lint_timeline: no wormhole_tpu package under {root!r}",
+              file=sys.stderr)
+        return 2
+    chk = TimelineChecker(root)
+    Engine(root, [chk]).run()
+    return chk.legacy_report()

@@ -1,0 +1,255 @@
+"""The one-walk, one-parse core the lint checkers plug into.
+
+The legacy lint scripts each rewalked ``wormhole_tpu/`` and reparsed
+every file; with nine checkers that is nine walks and up to nine AST
+parses per file. Here the :class:`Engine` walks once and hands every
+checker the same :class:`FileContext`, whose ``raw`` / ``code`` /
+``tree`` views are computed lazily and cached — the whole suite costs
+one read, one comment-strip and at most one ``ast.parse`` per file.
+
+The engine deliberately skips ``wormhole_tpu/analysis/`` itself: the
+checker sources quote the very patterns they hunt (forbidden call
+names, marker grammars), so scanning them would force every pattern
+literal to be obfuscated.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Checker",
+    "Diagnostic",
+    "Engine",
+    "FileContext",
+    "find_marker",
+    "iter_stmts",
+    "strip_comments",
+]
+
+# the package the whole suite scans, and the subtree it never scans
+PACKAGE = "wormhole_tpu"
+SKIP_PREFIX = "wormhole_tpu/analysis/"
+
+
+def strip_comments(text: str) -> str:
+    """Drop `#`-to-EOL per line (keeps line numbers aligned). Naive
+    about `#` inside string literals — good enough for lints whose
+    false positives land in a human-reviewed allowlist."""
+    return "\n".join(ln.split("#", 1)[0] for ln in text.splitlines())
+
+
+def _parse_source(source: str, path: str):
+    """The single ast.parse choke point — tests monkeypatch this to
+    prove the suite parses each file at most once."""
+    return ast.parse(source, path)
+
+
+def iter_stmts(body):
+    """Every statement in ``body``, recursively — including nested
+    function/class bodies — WITHOUT descending into expressions.
+    Checkers that only need statement-level shapes (defs, classes,
+    assignments) use this instead of a full ``ast.walk``: statements
+    are a small fraction of the node count."""
+    for stmt in body:
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                yield from iter_stmts(sub)
+        for h in getattr(stmt, "handlers", ()):
+            yield from iter_stmts(h.body)
+        for c in getattr(stmt, "cases", ()):
+            yield from iter_stmts(c.body)
+
+
+def find_marker(raw_lines: List[str], line: int, pattern,
+                above: int = 2) -> Optional["re.Match"]:
+    """First match of ``pattern`` on 1-based ``line`` or up to
+    ``above`` lines before it (the audit-marker window every checker
+    shares: same line or the few lines above)."""
+    lo = max(0, line - 1 - above)
+    for raw in raw_lines[lo:line]:
+        m = pattern.search(raw)
+        if m is not None:
+            return m
+    return None
+
+
+class FileContext:
+    """Lazy, cached views of one source file shared by all checkers."""
+
+    __slots__ = ("root", "path", "rel", "parse_count",
+                 "_raw", "_raw_lines", "_code", "_code_lines",
+                 "_tree", "_tree_done", "_nodes")
+
+    def __init__(self, root: str, path: str, rel: str) -> None:
+        self.root = root
+        self.path = path
+        self.rel = rel
+        self.parse_count = 0
+        self._raw: Optional[str] = None
+        self._raw_lines: Optional[List[str]] = None
+        self._code: Optional[str] = None
+        self._code_lines: Optional[List[str]] = None
+        self._tree = None
+        self._tree_done = False
+        self._nodes: Optional[list] = None
+
+    @property
+    def raw(self) -> str:
+        if self._raw is None:
+            with open(self.path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                self._raw = f.read()
+        return self._raw
+
+    @property
+    def raw_lines(self) -> List[str]:
+        if self._raw_lines is None:
+            self._raw_lines = self.raw.splitlines()
+        return self._raw_lines
+
+    @property
+    def code(self) -> str:
+        """The comment-stripped text (line numbers preserved)."""
+        if self._code is None:
+            self._code = strip_comments(self.raw)
+        return self._code
+
+    @property
+    def code_lines(self) -> List[str]:
+        if self._code_lines is None:
+            self._code_lines = self.code.splitlines()
+        return self._code_lines
+
+    @property
+    def tree(self):
+        """The AST, parsed at most once; ``None`` on a syntax error
+        (matching the legacy lints, which skip unparsable files)."""
+        if not self._tree_done:
+            self._tree_done = True
+            self.parse_count += 1
+            try:
+                self._tree = _parse_source(self.raw, self.path)
+            except SyntaxError:
+                self._tree = None
+        return self._tree
+
+    @property
+    def nodes(self) -> list:
+        """Flat list of every AST node — one ``ast.walk``, shared by
+        all checkers that sweep the whole tree. Empty on parse error."""
+        if self._nodes is None:
+            t = self.tree
+            self._nodes = [] if t is None else list(ast.walk(t))
+        return self._nodes
+
+
+class Diagnostic:
+    """One finding: ``CODE path:line: message`` (line optional)."""
+
+    __slots__ = ("code", "rel", "line", "message")
+
+    def __init__(self, code: str, rel: str, line: Optional[int],
+                 message: str) -> None:
+        self.code = code
+        self.rel = rel
+        self.line = line
+        self.message = message
+
+    def format(self) -> str:
+        where = self.rel if self.line is None else f"{self.rel}:{self.line}"
+        return f"{self.code} {where}: {self.message}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Diagnostic({self.format()!r})"
+
+
+class Checker:
+    """Base class: visit every file once, then finish.
+
+    Subclasses set ``name`` (the ``--only`` selector), ``code`` (the
+    diagnostic prefix) and override :meth:`visit` / :meth:`finish`.
+    ``warnings`` collects non-fatal stderr notes (stale allowlist
+    entries and the like) that never affect the verdict.
+    """
+
+    name = ""
+    code = ""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.diagnostics: List[Diagnostic] = []
+        self.warnings: List[str] = []
+
+    # -- hooks ---------------------------------------------------------
+
+    def precheck(self) -> Optional[str]:
+        """Return an error string when the tree is missing the layout
+        this checker needs (the legacy rc=2 path); None when ready."""
+        if not os.path.isdir(os.path.join(self.root, PACKAGE)):
+            return (f"lint_{self.name}: no {PACKAGE} package under "
+                    f"{self.root!r}")
+        return None
+
+    def visit(self, ctx: FileContext) -> None:
+        """Called once per scanned file."""
+
+    def finish(self) -> None:
+        """Called after the walk; emit diagnostics here (or in visit)."""
+
+    # -- helpers -------------------------------------------------------
+
+    def report(self, rel: str, line: Optional[int], message: str) -> None:
+        self.diagnostics.append(Diagnostic(self.code, rel, line, message))
+
+    def ok_line(self) -> str:
+        """One-line success summary for the unified runner."""
+        return f"{self.name}: OK"
+
+
+class Engine:
+    """Walk ``root/wormhole_tpu`` once, feeding every checker."""
+
+    def __init__(self, root: str, checkers: Iterable[Checker]) -> None:
+        self.root = root
+        self.checkers = list(checkers)
+        self.files_scanned = 0
+        self.parses = 0
+        self.parse_counts: Dict[str, int] = {}
+
+    def walk(self) -> Iterable[Tuple[str, str]]:
+        """Yield (path, rel) of every scanned file, in the legacy
+        order: directory walk with sorted entries, analysis/ skipped."""
+        pkg = os.path.join(self.root, PACKAGE)
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                if rel.startswith(SKIP_PREFIX):
+                    continue
+                yield path, rel
+
+    def run(self) -> List[Diagnostic]:
+        """Visit every file with every checker, finish each checker,
+        and return all diagnostics (checker registration order)."""
+        for path, rel in self.walk():
+            ctx = FileContext(self.root, path, rel)
+            self.files_scanned += 1
+            for chk in self.checkers:
+                chk.visit(ctx)
+            if ctx.parse_count:
+                self.parse_counts[rel] = ctx.parse_count
+                self.parses += ctx.parse_count
+        diags: List[Diagnostic] = []
+        for chk in self.checkers:
+            chk.finish()
+            diags.extend(chk.diagnostics)
+        return diags

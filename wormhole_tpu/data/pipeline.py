@@ -163,11 +163,14 @@ class DeviceFeed:
         self.name = name
         self.prep_label = prep_label
         self._lock = threading.Lock()
-        self._busy = {"parse": 0.0, "prep": 0.0, "put": 0.0}
-        self._stall = {"parse": 0.0, "prep": 0.0, "put": 0.0,
+        # Stage accumulators are written from the dispatcher, prep-pool,
+        # and consumer threads; every read-modify-write goes through
+        # _acc() or an explicit `with self._lock` block.
+        self._busy = {"parse": 0.0, "prep": 0.0, "put": 0.0}  # guarded-by: _lock
+        self._stall = {"parse": 0.0, "prep": 0.0, "put": 0.0,  # guarded-by: _lock
                        "consume": 0.0}
-        self._batches = 0
-        self._ring_max = 0
+        self._batches = 0  # guarded-by: _lock
+        self._ring_max = 0  # guarded-by: _lock
         self._threads: list = []
 
     # -- stats ---------------------------------------------------------------

@@ -273,13 +273,18 @@ class AsyncSGD:
             with obs.trace.span("collective:metrics_window",
                                 cat="collective",
                                 args={"site": "async_sgd/metrics_window"}):
+                # host-sync: windowed harvest — gates on a metrics
+                # buffer dispatched a full window ago, not this step's
                 metrics = jax.block_until_ready(metrics)
+            # host-sync: scalars already resolved by the window gate
             objv, num_ex, a, acc = (float(np.asarray(m))
                                     for m in metrics[:4])
             mon.update(int(num_ex), objv, a, acc)
             if kind == TRAIN and len(metrics) > 4:
+                # host-sync: scalar already resolved by the window gate
                 local.wdelta2 += float(np.asarray(metrics[4]))
             if pooled is not None and len(metrics) > 4:
+                # host-sync: margin pooled for AUC after the window gate
                 margin = np.asarray(metrics[4])
                 keep = row_mask >= 0  # real rows (weight-0 rows included)
                 pooled.append((margin[keep], labels[keep], row_mask[keep]))
@@ -343,10 +348,12 @@ class AsyncSGD:
                     margin = self._predict_forward.margins(batch)
                     keep = self._real_rows(batch)
                     m = (0.0, float((keep >= 0).sum()), 0.5, 0.0, margin)
+                    # host-sync: labels live on host already — no-op copy
                     inflight.append((m, np.asarray(batch.labels), keep))
                 else:
                     m = self.store.eval_step(batch)
                     keep = self._real_rows(batch)
+                    # host-sync: labels live on host already — no-op copy
                     inflight.append((m, np.asarray(batch.labels), keep))
         with self.timer.scope(pfx + "wait"):       # WaitMinibatch(0)
             while inflight:
@@ -631,6 +638,7 @@ class AsyncSGD:
             layout — [objv, num_ex, auc, acc, wdelta2|margin]."""
             if not spill:
                 return
+            # host-sync: one batched fetch drains the whole spill window
             fetched = jax.device_get([s[0] for s in spill])
             for (_m, labels_u8), metrics in zip(spill, fetched):
                 local.objv += float(metrics[0])
@@ -641,6 +649,7 @@ class AsyncSGD:
                 if kind == TRAIN:
                     local.wdelta2 += float(metrics[4])
                 elif pooled is not None and labels_u8 is not None:
+                    # host-sync: metrics fetched above — already host
                     margin = np.asarray(metrics[4])
                     real = labels_u8 != 255
                     pooled.append((margin[real],
@@ -663,6 +672,7 @@ class AsyncSGD:
                 return
             if not pending:
                 return
+            # host-sync: one batched fetch drains the display window
             fetched = jax.device_get([p[0] for p in pending])
             for (mdev, labels_u8), metrics in zip(pending, fetched):
                 local.objv += float(metrics[0])
@@ -679,6 +689,7 @@ class AsyncSGD:
                 if kind == TRAIN and len(metrics) > margin_ix:
                     local.wdelta2 += float(metrics[margin_ix])
                 if pooled is not None and labels_u8 is not None:
+                    # host-sync: metrics fetched above — already host
                     margin = np.asarray(metrics[margin_ix])
                     real = labels_u8 != 255
                     pooled.append((margin[real],
@@ -691,6 +702,7 @@ class AsyncSGD:
 
         def harvest(item) -> None:
             m, labels, is_spill = item
+            # host-sync: completion gate on a step dispatched last window
             jax.block_until_ready(m[0] if isinstance(m, tuple) else m)
             if is_spill:
                 spill.append((m, labels))
@@ -868,6 +880,7 @@ class AsyncSGD:
                 if kind == TRAIN:
                     local.wdelta2 += float(metrics[4])
                 elif pooled is not None and labels_u8 is not None:
+                    # host-sync: metrics fetched above — already host
                     margin = np.asarray(metrics[4])
                     real = labels_u8 != 255
                     pooled.append((margin[real],

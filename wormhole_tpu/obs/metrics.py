@@ -158,8 +158,12 @@ class Registry:
     the runtime arm of scripts/lint_knobs.py's unique-name rule."""
 
     def __init__(self) -> None:
-        self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        # Mutated by the learner thread (merge of remote snapshots) and
+        # the timeline sampler thread (counter/gauge declares) alike.
+        self._metrics: Dict[str, object] = {}  # guarded-by: _lock
+        # RLock: merge() holds it across the whole fold while calling
+        # counter()/gauge()/histogram(), which re-enter via _declare().
+        self._lock = threading.RLock()
 
     def _declare(self, cls, name: str, *args, **kwargs):
         with self._lock:
@@ -210,39 +214,47 @@ class Registry:
 
     def merge(self, snap: dict) -> None:
         """Fold another host's snapshot into this registry (Progress
-        POD merge semantics, per metric kind)."""
-        for name, row in snap.items():
-            kind = row["kind"]
-            if kind == "counter":
-                self.counter(name).value += float(row["value"])
-            elif kind == "gauge":
-                fresh = name not in self._metrics
-                g = self.gauge(name, agg=row.get("agg", "last"))
-                v = float(row["value"])
-                if fresh:
-                    # first contribution: adopt it outright — folding
-                    # against the fresh gauge's 0.0 would corrupt min
-                    # aggregation (min(0, v)) and negative-valued max
-                    g.value = v
-                elif g.agg == "sum":
-                    g.value += v
-                elif g.agg == "max":
-                    g.value = max(g.value, v)
-                elif g.agg == "min":
-                    g.value = min(g.value, v)
+        POD merge semantics, per metric kind).
+
+        The whole fold runs under ``_lock``: ``value += v`` and the
+        bin-wise histogram adds are read-modify-write sequences, and a
+        concurrent ``inc()`` from the timeline sampler thread between
+        the read and the write would be silently dropped."""
+        with self._lock:
+            for name, row in snap.items():
+                kind = row["kind"]
+                if kind == "counter":
+                    self.counter(name).value += float(row["value"])
+                elif kind == "gauge":
+                    fresh = name not in self._metrics
+                    g = self.gauge(name, agg=row.get("agg", "last"))
+                    v = float(row["value"])
+                    if fresh:
+                        # first contribution: adopt it outright — folding
+                        # against the fresh gauge's 0.0 would corrupt min
+                        # aggregation (min(0, v)) and negative-valued max
+                        g.value = v
+                    elif g.agg == "sum":
+                        g.value += v
+                    elif g.agg == "max":
+                        g.value = max(g.value, v)
+                    elif g.agg == "min":
+                        g.value = min(g.value, v)
+                    else:
+                        g.value = v
+                elif kind == "histogram":
+                    sv = row["value"]
+                    h = self.histogram(name, buckets=sv["buckets"])
+                    if list(h.buckets) != [float(b) for b in sv["buckets"]]:
+                        raise ValueError(
+                            f"histogram {name}: bucket layouts differ")
+                    h.bins = [a + int(b)
+                              for a, b in zip(h.bins, sv["bins"])]
+                    h.count += int(sv["count"])
+                    h.sum += float(sv["sum"])
                 else:
-                    g.value = v
-            elif kind == "histogram":
-                sv = row["value"]
-                h = self.histogram(name, buckets=sv["buckets"])
-                if list(h.buckets) != [float(b) for b in sv["buckets"]]:
                     raise ValueError(
-                        f"histogram {name}: bucket layouts differ")
-                h.bins = [a + int(b) for a, b in zip(h.bins, sv["bins"])]
-                h.count += int(sv["count"])
-                h.sum += float(sv["sum"])
-            else:
-                raise ValueError(f"metric {name}: unknown kind {kind!r}")
+                        f"metric {name}: unknown kind {kind!r}")
 
     def allreduce(self, mesh) -> None:
         """Merge this registry across hosts over the existing Progress

@@ -150,19 +150,22 @@ class TimelineSampler:
         self.spill_itv_s = float(spill_itv_s)
         self.rank = int(rank)
         self.observers = list(observers or [])
-        self._ring: deque = deque(maxlen=max(2, int(ring)))
+        # The ring is the only sampler state read from other threads
+        # (samples()/window()/spill()); everything else below is touched
+        # solely by the sampler loop.
+        self._ring: deque = deque(maxlen=max(2, int(ring)))  # guarded-by: _lock
         self._dropped = registry.counter(
             "timeline/dropped_samples",
             help="timeline ring samples evicted before spill "
                  "(mirrors trace/dropped_spans)")
         self._sys = system_gauges(registry)
         self._phase = ""
-        self._seq = 0
+        self._seq = 0  # owner-thread: timeline-sampler
         # cumulative seconds spent inside sample_once — the measured
         # sampler overhead bench.py reports as a fraction of phase wall
         self.tick_s = 0.0
-        self._prev: Dict[str, float] = {}
-        self._prev_mono = 0.0
+        self._prev: Dict[str, float] = {}  # owner-thread: timeline-sampler
+        self._prev_mono = 0.0  # owner-thread: timeline-sampler
         self._prog_mono = 0.0
         self._prog_ex = 0
         self._last_spill = 0.0
@@ -201,7 +204,7 @@ class TimelineSampler:
 
     # -- sampling ----------------------------------------------------
 
-    def sample_once(self) -> dict:
+    def sample_once(self) -> dict:  # owner-thread: timeline-sampler
         """Take one sample: refresh system gauges, flatten the registry
         (counters also as _rate, histograms also as _p50/_p99), stamp
         the timeline fields, append to the ring."""

@@ -99,7 +99,10 @@ class ExchangeEngine:
         bound = int(queue_depth) if queue_depth > 0 else self.tau + 1
         # +1 headroom: a control ticket may queue behind tau deltas
         self._q = WindowQueue(bound + 1)
-        self._pending: deque = deque()  # delta tickets, submission order
+        # Delta tickets in submission order. Only the trainer thread
+        # appends (submit) and pops (gate/quiesce); the drain thread
+        # never sees this deque — it consumes tickets through _q.
+        self._pending: deque = deque()  # owner-thread: trainer
         self._metrics = metrics
         # live-rejoin replay log (ft/rejoin.ReplayLog or None): every
         # successfully reduced delta window is recorded from the drain
@@ -146,7 +149,7 @@ class ExchangeEngine:
 
     # -- trainer thread ----------------------------------------------
 
-    def submit(self, fn: Callable[[], Any]) -> Ticket:
+    def submit(self, fn: Callable[[], Any]) -> Ticket:  # owner-thread: trainer
         """Enqueue one delta-window exchange; returns immediately."""
         if self._stopped:
             raise RuntimeError("exchange engine stopped")
@@ -199,7 +202,7 @@ class ExchangeEngine:
         self._q.close()
         self._thread.join(timeout=30.0)
 
-    def _collect_front(self) -> Ticket:
+    def _collect_front(self) -> Ticket:  # owner-thread: trainer
         t = self._pending.popleft()
         self._wait(t)
         if t.error is not None:

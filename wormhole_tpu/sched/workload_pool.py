@@ -61,10 +61,10 @@ class WorkloadPool:
         # set, or between finish()'s pop and _done_ids.add) can
         # double-assign a part or drop it entirely
         self._lock = threading.RLock()
-        self._queue: List[Workload] = []
-        self._assigned: Dict[int, _Assigned] = {}
-        self._done_ids: set = set()
-        self._durations: List[float] = []
+        self._queue: List[Workload] = []  # guarded-by: _lock
+        self._assigned: Dict[int, _Assigned] = {}  # guarded-by: _lock
+        self._done_ids: set = set()  # guarded-by: _lock
+        self._durations: List[float] = []  # guarded-by: _lock
         self._next_id = 0
 
     # -- reference surface --------------------------------------------------
@@ -201,7 +201,8 @@ class WorkloadPool:
     #
     # (see also ReplicatedRounds below for the deterministic multihost form)
 
-    def _requeue_stragglers(self) -> None:
+    # Private helper: get() holds the RLock across the call.
+    def _requeue_stragglers(self) -> None:  # guarded-by: _lock
         if not self._durations:
             return  # no baseline yet — can't call anything a straggler
         mean = sum(self._durations) / len(self._durations)

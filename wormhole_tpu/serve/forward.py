@@ -53,7 +53,9 @@ class ForwardStep:
     def __init__(self, margin_fn: Callable[[Any, SparseBatch], jax.Array],
                  params: Any, loss: str = "logit") -> None:
         self._lock = threading.Lock()
-        self._params = params
+        # Swapped by the snapshot poller thread while serve consumers
+        # read; every access goes through params()/swap() under _lock.
+        self._params = params  # guarded-by: _lock
         self.loss = loss
         self.compiles = 0
         sigmoid = loss == "logit"
@@ -128,6 +130,8 @@ class ForwardStep:
 
     def predict(self, batch: SparseBatch) -> np.ndarray:
         """Blocking host predictions for one padded batch."""
+        # host-sync: the contract IS a host array — callers wanting
+        # async results use margins()/__call__ and keep device handles
         return np.asarray(self._fwd(self.params, batch)[1])
 
 

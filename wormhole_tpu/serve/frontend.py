@@ -142,14 +142,16 @@ class ServeFrontend:
         self._metrics = None
         if registry is not None:
             self._metrics = serve_metrics(registry)
-        self._lat: deque = deque(maxlen=_LAT_WINDOW)
+        # Flush-thread counters read by stats() from client threads;
+        # both sides take _lock around every touch.
+        self._lat: deque = deque(maxlen=_LAT_WINDOW)  # guarded-by: _lock
         self._p99_next = 0.0          # next rolling-p99 refresh (mono)
         self._lock = threading.Lock()
-        self._requests = 0
-        self._batches = 0
-        self._deadline_flushes = 0
-        self._full_flushes = 0
-        self._depth_max = 0
+        self._requests = 0  # guarded-by: _lock
+        self._batches = 0  # guarded-by: _lock
+        self._deadline_flushes = 0  # guarded-by: _lock
+        self._full_flushes = 0  # guarded-by: _lock
+        self._depth_max = 0  # guarded-by: _lock
         self._trunc_warned = False
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -251,7 +253,9 @@ class ServeFrontend:
             with trace.span("serve:forward", cat="serve",
                             args={"rows": len(group)}):
                 margin, pred = self.forward(batch)
+                # host-sync: flush must resolve futures with host floats
                 margin = np.asarray(margin)
+                # host-sync: covered by the same resolve-barrier above
                 pred = np.asarray(pred)
         except BaseException as exc:  # deliver, don't kill the loop
             log.warning("serve flush failed: %s", exc)
@@ -279,6 +283,7 @@ class ServeFrontend:
             if now >= self._p99_next:
                 self._p99_next = now + _P99_REFRESH_S
                 with self._lock:
+                    # host-sync: _lat holds host floats, no device copy
                     arr = np.asarray(self._lat, np.float64)
                 if arr.size:
                     p99_g.set(float(np.percentile(arr, 99)) * 1e3)

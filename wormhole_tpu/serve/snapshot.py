@@ -56,12 +56,15 @@ class SnapshotPoller:
         self.template = template_state
         self.forward = forward
         self.poll_itv = float(poll_itv)
-        self.version = int(start_version)
-        self.swaps = 0
+        # Advanced only by poll_once(), which runs either inline (tests,
+        # manual drive) or on the single serve-snapshot thread — never
+        # both at once. Readers get monotonic ints, no torn state.
+        self.version = int(start_version)  # owner-thread: serve-snapshot
+        self.swaps = 0  # owner-thread: serve-snapshot
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def poll_once(self) -> bool:
+    def poll_once(self) -> bool:  # owner-thread: serve-snapshot
         """Check for a newer version; swap it in if found. Returns True
         on a swap. Races with checkpoint GC (the version can vanish
         between listing and reading) and half-written files surface as

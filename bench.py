@@ -441,6 +441,111 @@ def bench_device_sparse() -> float:
     return 30 * MINIBATCH / elapsed
 
 
+def bench_bigmodel() -> dict:
+    """Host-resident cold tier (bigmodel/paged.py): the bucket space
+    grows 16x past the device hot-set budget while the per-step rate is
+    held against a dense anchor — the same batch geometry on a plain
+    store sized to the hot tier, everything device-resident. The
+    Criteo-like key mix (90% of keys from a core inside the hot budget,
+    10% uniform over the full space) is what makes tiering viable: the
+    LFU working set absorbs the core while the accumulated uniform tail
+    overflows the hot tier and exercises the evict/writeback path.
+    Paging traffic is reported both in the phase record and as
+    ``page/*`` registry counters (bench_check gates bytes_h2d > 0 and
+    the paged/dense rate ratio floor)."""
+    import jax
+    from wormhole_tpu.bigmodel import PagedStore
+    from wormhole_tpu.data.feed import SparseBatch
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    rng = np.random.default_rng(7)
+    HOT = 1 << 16
+    NB = 1 << 20                 # 16x past the hot budget
+    MB, NNZ, KP = 4096, 8, 1 << 14
+    STEPS = 48
+    core = rng.choice(NB, size=int(HOT * 3 / 4), replace=False)
+
+    def mk_batch(rng):
+        k = int(KP * 0.9)
+        keys = np.unique(np.concatenate([
+            rng.choice(core, size=int(k * 0.9), replace=False),
+            rng.integers(0, NB, size=k - int(k * 0.9))]))
+        k = keys.size
+        uniq = np.zeros(KP, np.int64)
+        uniq[:k] = keys
+        key_mask = np.zeros(KP, np.float32)
+        key_mask[:k] = 1.0
+        cols = rng.integers(0, k, size=(MB, NNZ)).astype(np.int32)
+        vals = np.ones((MB, NNZ), np.float32)
+        labels = (rng.random(MB) < 0.25).astype(np.float32)
+        return SparseBatch(cols=cols, vals=vals, labels=labels,
+                           row_mask=np.ones(MB, np.float32),
+                           uniq_keys=uniq, key_mask=key_mask)
+
+    batches = [mk_batch(rng) for _ in range(24)]
+
+    def mk_handle():
+        return FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+
+    hot = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                       mk_handle())
+    # late_window at the feed-safety minimum: with 24 distinct batches
+    # the re-use distance of an evicted bucket (24 plans) clears the
+    # window, so refills stage through the transfer ring (overlapped)
+    # instead of the synchronous consumer-side late path.
+    from wormhole_tpu.bigmodel import late_window_for
+    ps = PagedStore(hot, NB, late_window=late_window_for(2, 2))
+
+    def paged_window(steps):
+        src = (batches[i % len(batches)] for i in range(steps))
+        t0 = time.perf_counter()
+        ps.train_sparse(src, workers=2, ring_depth=2)
+        jax.block_until_ready(ps.hot.slots)
+        return time.perf_counter() - t0
+
+    paged_window(6)   # warmup: compiles + fills the working set
+    paged_s = _median_window(lambda: paged_window(STEPS), repeats=3)
+
+    # dense anchor: identical geometry folded into the hot-size table,
+    # fully device-resident, batches pre-placed (its best case)
+    anchor = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                          mk_handle())
+    import dataclasses as _dc
+    dev = [jax.device_put(_dc.replace(
+               b, uniq_keys=(np.asarray(b.uniq_keys) % HOT)))
+           for b in batches]
+
+    def anchor_window(steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            anchor.train_step(dev[i % len(dev)])
+        jax.block_until_ready(anchor.slots)
+        return time.perf_counter() - t0
+
+    anchor_window(6)  # warmup
+    dense_s = _median_window(lambda: anchor_window(STEPS), repeats=3)
+
+    stats = ps.stats()
+    ps.to_registry()
+    paged_rate = STEPS * MB / paged_s
+    dense_rate = STEPS * MB / dense_s
+    return {
+        "bigmodel_ex_per_sec": round(paged_rate, 1),
+        "dense_anchor_ex_per_sec": round(dense_rate, 1),
+        "bigmodel_over_dense": round(paged_rate / dense_rate, 4),
+        "nb_total": NB,
+        "hot_buckets": HOT,
+        "nb_over_hot": NB // HOT,
+        "bytes_h2d": int(stats["bytes_h2d"]),
+        "bytes_d2h": int(stats["bytes_d2h"]),
+        "pages_in": int(stats["pages_in"]),
+        "pages_out": int(stats["pages_out"]),
+        "late_fills": int(stats["late_fills"]),
+        "hit_rate": round(stats["hit_rate"], 4),
+    }
+
+
 def make_tile_stores() -> dict:
     """One store per tile-step flavor, shared by the absolute-rate
     phases AND bench_channel_ratios — each store's fused step compiles
@@ -1861,7 +1966,8 @@ def bench_hierarchy() -> dict:
 PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "tile_fused", "device_sparse",
-          "device_dense_apply", "scale_curve", "multichip", "hierarchy",
+          "device_dense_apply", "scale_curve", "bigmodel", "multichip",
+          "hierarchy",
           "serve", "comm_filters", "async_ps", "kmeans", "lbfgs", "gbdt",
           "chaos", "rejoin"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
@@ -1957,6 +2063,10 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
         extra["tile_fused_vs_split"] = results["tile_fused"]
     if "scale_curve" in results:
         extra["scale_curve_tile_step"] = results["scale_curve"]
+    if "bigmodel" in results:
+        extra["bigmodel"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in results["bigmodel"].items()}
     if "serve" in results:
         def _round_serve(v):
             if isinstance(v, dict):
@@ -2111,6 +2221,7 @@ def main(argv=None) -> None:
         "device_sparse": bench_device_sparse,
         "device_dense_apply": bench_device_dense_apply,
         "scale_curve": lambda: bench_scale_curve(workdir, rng),
+        "bigmodel": bench_bigmodel,
         "multichip": bench_multichip,
         "hierarchy": bench_hierarchy,
         "serve": bench_serve,

@@ -32,6 +32,13 @@ regresses against its predecessor:
   ``--min-wire-ratio``; the same ratio keys also ride the pairwise
   ``--tol`` machinery (higher is better) so a codec that quietly stops
   compressing gates like a throughput drop.
+- **Bigmodel paging** (absolute + trend): the NEWEST run's
+  ``bigmodel.bytes_h2d`` must be > 0 (the cold tier paged real rows
+  through the ring — zero means the phase never left the hot set) and
+  ``bigmodel.bigmodel_over_dense`` must clear ``--min-bigmodel-ratio``;
+  the same ratio also rides the pairwise ``--tol`` machinery (higher is
+  better), so a paging path that quietly starts stalling the consumer
+  gates like a throughput drop.
 - **SLO timeline** (``--slo``, absolute): the NEWEST run's per-phase
   ``timeline`` blocks (bench.py ``--sample-itv`` sampler;
   ``obs/timeline.summarize``) must keep their first-vs-last-quartile
@@ -96,6 +103,10 @@ _DEBT_PAT = re.compile(r"recovery_debt_s$")
 # semantics — their payloads are synthetic fixtures, not the 2D sweep)
 _BYTES_WIRE_PAT = re.compile(r"bytes_wire$")
 _WIRE_RATIO_PAT = re.compile(r"wire_ratio$")
+# bigmodel-phase keys, gated only under the bigmodel block (bytes_h2d
+# also appears in raw feed stats with different semantics)
+_BM_BYTES_PAT = re.compile(r"bytes_h2d$")
+_BM_RATIO_PAT = re.compile(r"bigmodel_over_dense$")
 _LEDGER_FRACS = ("unattributed", "residual_stall")
 # default --min-scaling: the measured CPU fake-8-device trajectory sits
 # at 0.09-0.13 across the swept shapes (all "devices" share the host
@@ -120,6 +131,14 @@ _MAX_RECOVERY_DEBT = 60.0
 # swept dense bucket deltas; 2.0 passes that with headroom while
 # catching a chain that silently degrades to the raw codec (ratio -> 1)
 _MIN_WIRE_RATIO = 2.0
+# absolute floor on the newest BENCH run's bigmodel.bigmodel_over_dense
+# (paged 16x-oversubscribed table vs the dense hot-size anchor, same
+# batch geometry). The single-core CPU host measures ~0.58 with zero
+# pipeline overlap available — 0.4 passes that with headroom while
+# catching a paging path that collapses to synchronous fills. A real
+# TPU host overlaps the host-side plan/page work under the device step
+# and should be gated at ~0.8 (the ISSUE's within-20% target).
+_MIN_BIGMODEL_RATIO = 0.4
 # --slo defaults: absolute gates over the newest run's per-phase
 # `timeline` blocks (bench.py --sample-itv; obs/timeline.summarize).
 # Drift is the first-vs-last-quartile ex/s decay WITHIN a phase — a
@@ -275,6 +294,17 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
                 f"{key}: {cv:.2f} < {pv:.2f} * {1 - tol:.2f} "
                 f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
                 "hierarchy wire compression regression")
+    pbm, cbm = (bigmodel_keys(prev, _BM_RATIO_PAT),
+                bigmodel_keys(cur, _BM_RATIO_PAT))
+    for key in sorted(set(pbm) & set(cbm)):
+        pv, cv = pbm[key], cbm[key]
+        if pv <= 0:
+            continue
+        if cv < pv * (1.0 - tol):
+            bad.append(
+                f"{key}: {cv:.3f} < {pv:.3f} * {1 - tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
+                "bigmodel paged/dense ratio regression")
     pfracs, cfracs = ledger_fracs(prev), ledger_fracs(cur)
     for key in sorted(set(pfracs) & set(cfracs)):
         if cfracs[key] > pfracs[key] + tol_frac:
@@ -357,6 +387,33 @@ def hier_wire_gate(name: str, parsed: dict,
     return bad
 
 
+def bigmodel_keys(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
+    """``_keys_matching`` restricted to paths under a ``bigmodel``
+    block — the paging gates apply to the cold-tier sweep only."""
+    return {p: v for p, v in _keys_matching(parsed, pat).items()
+            if ".bigmodel." in f".{p}."}
+
+
+def bigmodel_gate(name: str, parsed: dict,
+                  min_ratio: float) -> List[str]:
+    """Absolute gates on the newest run's bigmodel phase: real paged
+    bytes on the H2D leg (zero = the sweep never overflowed the hot
+    set, so it measured nothing) and a floor on the paged/dense rate
+    ratio — the cold tier's whole point is growing the bucket space
+    without giving the throughput back."""
+    bad = [
+        f"{key}: {v:.0f} <= 0 ({name}) — bigmodel phase paged no "
+        "measured H2D bytes through the ring"
+        for key, v in sorted(bigmodel_keys(parsed, _BM_BYTES_PAT).items())
+        if v <= 0]
+    bad += [
+        f"{key}: {v:.3f} < --min-bigmodel-ratio {min_ratio:.3f} "
+        f"({name}) — paged/dense throughput below the absolute floor"
+        for key, v in sorted(bigmodel_keys(parsed, _BM_RATIO_PAT).items())
+        if v < min_ratio]
+    return bad
+
+
 def timeline_blocks(parsed: dict) -> Dict[str, dict]:
     """Dotted path -> per-phase ``timeline`` block (bench.py --out
     telemetry, ``{"timeline": {...}}`` anywhere under ``parsed``)."""
@@ -413,7 +470,8 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      max_recovery_debt: float, slo: bool = False,
                      max_drift: float = _MAX_DRIFT,
                      max_burn: float = _MAX_BURN,
-                     min_wire_ratio: float = _MIN_WIRE_RATIO
+                     min_wire_ratio: float = _MIN_WIRE_RATIO,
+                     min_bigmodel_ratio: float = _MIN_BIGMODEL_RATIO
                      ) -> Tuple[List[str], int, int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
@@ -425,6 +483,7 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
         failures.extend(fused_floor(*runs[-1], min_fused_ratio))
         failures.extend(debt_ceiling(*runs[-1], max_recovery_debt))
         failures.extend(hier_wire_gate(*runs[-1], min_wire_ratio))
+        failures.extend(bigmodel_gate(*runs[-1], min_bigmodel_ratio))
     if slo and runs:
         failures.extend(slo_gate(*runs[-1], max_drift=max_drift,
                                  max_burn=max_burn))
@@ -448,7 +507,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
         max_recovery_debt: float = _MAX_RECOVERY_DEBT,
         slo: bool = False, max_drift: float = _MAX_DRIFT,
         max_burn: float = _MAX_BURN,
-        min_wire_ratio: float = _MIN_WIRE_RATIO) -> int:
+        min_wire_ratio: float = _MIN_WIRE_RATIO,
+        min_bigmodel_ratio: float = _MIN_BIGMODEL_RATIO) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
@@ -457,7 +517,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
                                    min_fused_ratio, max_recovery_debt,
                                    slo=slo, max_drift=max_drift,
                                    max_burn=max_burn,
-                                   min_wire_ratio=min_wire_ratio)
+                                   min_wire_ratio=min_wire_ratio,
+                                   min_bigmodel_ratio=min_bigmodel_ratio)
         failures.extend(f)
         pairs += p
         compared += c
@@ -508,6 +569,13 @@ def main(argv=None) -> int:
                          "hierarchy.*_wire_ratio values (default "
                          f"{_MIN_WIRE_RATIO}; quant8+zlib measures "
                          "~4.2x on the swept dense bucket deltas)")
+    ap.add_argument("--min-bigmodel-ratio", type=float,
+                    default=_MIN_BIGMODEL_RATIO,
+                    help="absolute floor on the newest BENCH run's "
+                         "bigmodel.bigmodel_over_dense (default "
+                         f"{_MIN_BIGMODEL_RATIO}, calibrated to the "
+                         "single-core CPU host; gate a real TPU host "
+                         "at ~0.8)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
@@ -531,7 +599,8 @@ def main(argv=None) -> int:
                max_recovery_debt=args.max_recovery_debt,
                slo=args.slo, max_drift=args.max_drift,
                max_burn=args.max_burn,
-               min_wire_ratio=args.min_wire_ratio)
+               min_wire_ratio=args.min_wire_ratio,
+               min_bigmodel_ratio=args.min_bigmodel_ratio)
 
 
 if __name__ == "__main__":

@@ -405,5 +405,103 @@ def test_central_tables_resolve():
             assert f"def {leaf}" in src, f"{rel}: {name} vanished"
 
 
+def test_bigmodel_paths_registered_in_central_tables():
+    """The paging loop is covered by both disciplines: the consumer-side
+    tier moves sit in HOT_PATHS (host syncs there must carry a
+    `# host-sync:` justification) and the pager/store shared state sits
+    in SHARED_STATE (ownership annotations required)."""
+    from wormhole_tpu.analysis.checkers.hostsync import HOT_PATHS
+    from wormhole_tpu.analysis.checkers.threads import SHARED_STATE
+
+    hot = HOT_PATHS["wormhole_tpu/bigmodel/paged.py"]
+    for fn in ("PagedStore.apply_plan", "PagedStore._resolve_pending",
+               "PagedStore.flush", "PagedStore.stage_fresh"):
+        assert fn in hot, fn
+    pager_attrs = SHARED_STATE["wormhole_tpu/bigmodel/pager.py"][
+        "BucketPager"]
+    assert "slot_of" in pager_attrs and "_last_evict" in pager_attrs
+    store_attrs = SHARED_STATE["wormhole_tpu/bigmodel/paged.py"][
+        "PagedStore"]
+    assert "cold" in store_attrs and "_pending" in store_attrs
+
+
+def test_hostsync_flags_unmarked_paging_writeback(tmp_path):
+    """Planted violation, paging-shaped: a cold-tier writeback loop
+    that materializes device rows (`np.asarray`) without the
+    `# host-sync:` justification must flag — this is exactly the
+    discipline the real PagedStore._resolve_pending carries."""
+    diags = _run(tmp_path, HostSyncChecker, """\
+import numpy as np
+
+HOT_PATHS = ("Paged.resolve",)
+
+class Paged:
+    def resolve(self, pending, cold):
+        for buckets, rows_dev in pending:
+            cold[buckets] = np.asarray(rows_dev)
+        return cold
+""")
+    assert len(diags) == 1
+    assert diags[0].code == "WH-HOSTSYNC"
+    assert "np.asarray" in diags[0].message
+
+
+def test_hostsync_marked_paging_writeback_passes(tmp_path):
+    assert _run(tmp_path, HostSyncChecker, """\
+import numpy as np
+
+HOT_PATHS = ("Paged.resolve",)
+
+class Paged:
+    def resolve(self, pending, cold):
+        for buckets, rows_dev in pending:
+            # host-sync: writeback must land before later fills
+            cold[buckets] = np.asarray(rows_dev)
+        return cold
+""") == []
+
+
+def test_thread_flags_unannotated_pager_mutation(tmp_path):
+    """Planted violation, pager-shaped: residency arrays declared with
+    an owner thread, then mutated from a method with no ownership
+    annotation on the site or the def line — the discipline the real
+    BucketPager.plan carries on its def line."""
+    diags = _run(tmp_path, ThreadChecker, """\
+import numpy as np
+
+SHARED_STATE = {"Pager": ("slot_of", "_seq")}
+
+class Pager:
+    def __init__(self, nb):
+        self.slot_of = np.full(nb, -1)  # owner-thread: feed-dispatch
+        self._seq = 0  # owner-thread: feed-dispatch
+
+    def plan(self, uniq):
+        self.slot_of[uniq] = 1
+        self._seq += 1
+""")
+    assert len(diags) == 2
+    assert all(d.code == "WH-THREAD" for d in diags)
+    assert any("slot_of" in d.message for d in diags)
+    assert any("_seq" in d.message for d in diags)
+
+
+def test_thread_pager_mutation_annotated_on_def_line_passes(tmp_path):
+    assert _run(tmp_path, ThreadChecker, """\
+import numpy as np
+
+SHARED_STATE = {"Pager": ("slot_of", "_seq")}
+
+class Pager:
+    def __init__(self, nb):
+        self.slot_of = np.full(nb, -1)  # owner-thread: feed-dispatch
+        self._seq = 0  # owner-thread: feed-dispatch
+
+    def plan(self, uniq):  # owner-thread: feed-dispatch
+        self.slot_of[uniq] = 1
+        self._seq += 1
+""") == []
+
+
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))

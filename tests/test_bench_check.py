@@ -442,3 +442,66 @@ def test_other_phase_wire_keys_not_hier_gated(tmp_path):
                               "async_ps": {"tau0_wire_ratio": 1.05}}))
     r = _run("--dir", d)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _bigmodel(paged=540_000.0, dense=930_000.0, ratio=0.58,
+              bytes_h2d=2_159_028):
+    return {"bigmodel": {"bigmodel_ex_per_sec": paged,
+                         "dense_anchor_ex_per_sec": dense,
+                         "bigmodel_over_dense": ratio,
+                         "bytes_h2d": bytes_h2d,
+                         "bytes_d2h": 1_354_824}}
+
+
+def test_bigmodel_zero_h2d_bytes_fails(tmp_path):
+    """The paging acceptance gate: the cold tier must page real rows
+    through the ring — zero H2D bytes means the sweep never overflowed
+    the hot set and measured a plain dense run."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _bigmodel(bytes_h2d=0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "paged no measured H2D bytes" in r.stderr
+
+
+def test_bigmodel_ratio_floor_gates_newest_run(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _bigmodel(ratio=0.2)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-bigmodel-ratio" in r.stderr
+    # the flag relaxes the floor, same machinery as the other absolutes
+    r2 = _run("--dir", d, "--min-bigmodel-ratio", "0.1")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_bigmodel_ratio_trend_rides_tol(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _bigmodel(ratio=0.9)))
+    _write_run(d, 2, _parsed(100_000.0, _bigmodel(ratio=0.45)))  # halved
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "paged/dense ratio regression" in r.stderr
+    # within --tol the same pair passes
+    r2 = _run("--dir", d, "--tol", "0.6")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_bigmodel_rate_keys_auto_gated(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _bigmodel(paged=540_000.0)))
+    _write_run(d, 2, _parsed(100_000.0, _bigmodel(paged=200_000.0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "bigmodel_ex_per_sec" in r.stderr
+
+
+def test_other_phase_h2d_keys_not_bigmodel_gated(tmp_path):
+    """Feed stats carry same-named bytes_h2d leaves with different
+    semantics — the bigmodel floors must not reach outside the
+    bigmodel block."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"e2e_stream": {"bytes_h2d": 0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,279 @@
+"""Bigmodel cold tier (wormhole_tpu/bigmodel): the LFU pager's
+deterministic planning, the paged store's bitwise parity against a
+full-size table, worker-count independence of both the learned state
+and the paging counters, and the paging spans' ledger bucket."""
+
+import numpy as np
+import pytest
+
+from wormhole_tpu.bigmodel import (BucketPager, PagedStore,
+                                   late_window_for)
+from wormhole_tpu.bigmodel.paged import _pad_len, _pad_pair
+from wormhole_tpu.data.feed import SparseBatch
+from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+from wormhole_tpu.ops.penalty import L1L2
+
+NB, HOT, KP, MB, NNZ = 512, 64, 32, 8, 4
+
+
+# -- pager (pure host state, no jax) -----------------------------------
+
+def test_late_window_for_bounds_pipeline_lead():
+    # 2w queue + w in flight + ring + transfer&consumer + prefetch slack
+    assert late_window_for(2, 2, prefetch=8) == 18
+    assert late_window_for(0, 2, prefetch=0) == 4
+    # serial path still gets the prefetch slack
+    assert late_window_for(0, 2) == 12
+
+
+def test_pager_free_slots_before_eviction():
+    p = BucketPager(16, 4)
+    plan = p.plan(np.array([3, 1, 2]))
+    assert plan.victim_slots.size == 0
+    assert np.array_equal(plan.uniq, [1, 2, 3])   # deduped + sorted
+    # free slots handed out in slot order
+    assert np.array_equal(np.sort(plan.miss_slots), [0, 1, 2])
+    assert p.stats()["pages_out"] == 0
+
+
+def test_pager_lfu_victim_order_is_freq_then_slot():
+    p = BucketPager(16, 4)
+    p.plan(np.array([0, 1, 2, 3]))      # fill slots 0..3
+    p.plan(np.array([0, 1]))            # freq(b0,b1)=2; b2,b3 stay at 1
+    plan = p.plan(np.array([4, 5]))     # needs 2 victims
+    # lowest (freq, slot): buckets 2 and 3 in their slot order
+    assert np.array_equal(plan.victim_buckets, [2, 3])
+    assert np.array_equal(plan.victim_slots, plan.miss_slots)
+    # next eviction: among freq-1 residents (4, 5), lowest slot first
+    plan2 = p.plan(np.array([6]))
+    assert np.array_equal(plan2.victim_buckets, [4])
+
+
+def test_pager_hit_does_not_page():
+    p = BucketPager(16, 4)
+    p.plan(np.array([0, 1]))
+    plan = p.plan(np.array([0, 1]))
+    assert plan.miss_buckets.size == 0 and plan.victim_slots.size == 0
+    s = p.stats()
+    assert s["hits"] == 2 and s["pages_in"] == 2
+
+
+def test_pager_recently_evicted_refill_is_late():
+    p = BucketPager(16, 2, late_window=4)
+    p.plan(np.array([0, 1]))
+    p.plan(np.array([2]))               # evicts bucket 0 (freq tie, slot 0)
+    plan = p.plan(np.array([0]))        # refill inside the window
+    assert np.array_equal(plan.miss_buckets, [0])
+    assert not plan.fresh[0] and plan.late[0]
+    assert p.stats()["late_fills"] == 1
+    # a never-evicted bucket always fills fresh
+    plan2 = p.plan(np.array([5]))
+    assert plan2.fresh[0]
+
+
+def test_pager_determinism_across_replays():
+    rng = np.random.default_rng(3)
+    streams = [rng.integers(0, 128, size=rng.integers(4, 16))
+               for _ in range(60)]
+    a, b = BucketPager(128, 16), BucketPager(128, 16)
+    for s in streams:
+        pa, pb = a.plan(s), b.plan(s)
+        assert np.array_equal(pa.victim_buckets, pb.victim_buckets)
+        assert np.array_equal(pa.miss_slots, pb.miss_slots)
+        assert np.array_equal(pa.fresh, pb.fresh)
+    assert a.stats() == b.stats()
+    assert np.array_equal(a.resident_buckets(), b.resident_buckets())
+
+
+def test_pager_victims_match_full_lexsort_oracle():
+    """The argpartition fast path must reproduce the full-sort LFU
+    order exactly — same victim SET and same victim ORDER."""
+    rng = np.random.default_rng(11)
+    p = BucketPager(256, 16)
+    for _ in range(80):
+        s = rng.integers(0, 256, size=rng.integers(2, 14))
+        uniq = np.unique(s.astype(np.int64))
+        res = p.slot_of[uniq]
+        hit_slots = res[res >= 0]
+        miss = int((res < 0).sum())
+        free = int((p.bucket_of < 0).sum())
+        need = miss - min(miss, free)
+        expect = None
+        if need > 0:
+            cand = np.ones(p.hot_buckets, bool)
+            cand[hit_slots] = False
+            cand &= p.bucket_of >= 0
+            cs = np.flatnonzero(cand)
+            order = np.lexsort((cs, p.freq[cs]))
+            expect = cs[order[:need]]
+        plan = p.plan(s)
+        if expect is not None:
+            assert np.array_equal(plan.victim_slots, expect)
+
+
+def test_pager_rejects_oversized_block():
+    p = BucketPager(64, 4)
+    with pytest.raises(ValueError, match="hot tier holds"):
+        p.plan(np.arange(5))
+
+
+def test_pager_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        BucketPager(16, 0)
+    with pytest.raises(ValueError):
+        BucketPager(16, 32)
+
+
+# -- padding quanta -----------------------------------------------------
+
+def test_pad_len_power_of_two_chunks():
+    assert _pad_len(1, 64) == 64
+    assert _pad_len(64, 64) == 64
+    assert _pad_len(65, 64) == 128
+    assert _pad_len(200, 64) == 256
+
+
+def test_pad_pair_duplicates_first_row():
+    idx = np.array([5, 9], np.int64)
+    rows = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    idx_p, rows_p = _pad_pair(idx, rows, 4)
+    assert idx_p.shape == (4,) and rows_p.shape == (4, 2)
+    assert (idx_p[2:] == 5).all()
+    assert (rows_p[2:] == rows[0]).all()
+
+
+# -- paged store vs the full-size oracle --------------------------------
+
+def _mk_handle():
+    return FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
+
+
+def _mk_batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(4, KP))
+        keys = np.sort(rng.choice(NB, size=k, replace=False))
+        uniq = np.zeros(KP, np.int64)
+        uniq[:k] = keys
+        key_mask = np.zeros(KP, np.float32)
+        key_mask[:k] = 1.0
+        out.append(SparseBatch(
+            cols=rng.integers(0, k, size=(MB, NNZ)).astype(np.int32),
+            vals=rng.random((MB, NNZ), np.float32),
+            labels=(rng.random(MB) < 0.3).astype(np.float32),
+            row_mask=np.ones(MB, np.float32),
+            uniq_keys=uniq, key_mask=key_mask))
+    return out
+
+
+def _oracle_slots(batches):
+    full = ShardedStore(StoreConfig(num_buckets=NB, loss="logit"),
+                        _mk_handle())
+    for b in batches:
+        full.train_step(b)
+    return np.asarray(full.slots)
+
+
+def _paged_run(batches, workers):
+    hot = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                       _mk_handle())
+    ps = PagedStore(hot, NB, late_window=late_window_for(2, 2))
+    n = ps.train_sparse(iter(batches), workers=workers)
+    assert n == len(batches)
+    return ps
+
+
+def test_paged_sparse_bitwise_parity_with_forced_evictions():
+    batches = _mk_batches(30)
+    oracle = _oracle_slots(batches)
+    ps = _paged_run(batches, workers=0)
+    s = ps.stats()
+    # the stream must actually exercise the tier moves, late path
+    # included, or the parity claim is vacuous
+    assert s["pages_out"] > 0 and s["late_fills"] > 0
+    assert s["bytes_h2d"] > 0 and s["bytes_d2h"] > 0
+    assert np.array_equal(ps.flush(), oracle)
+
+
+def test_paged_workers_do_not_change_state_or_counters():
+    batches = _mk_batches(30, seed=1)
+    serial = _paged_run(batches, workers=0)
+    threaded = _paged_run(batches, workers=2)
+    assert np.array_equal(serial.flush(), threaded.flush())
+    for key in ("hits", "misses", "pages_in", "pages_out",
+                "late_fills", "bytes_h2d"):
+        assert serial.stats()[key] == threaded.stats()[key], key
+    assert np.array_equal(serial.flush(), _oracle_slots(batches))
+
+
+def test_paged_ring_accounts_page_h2d_stage():
+    ps = _paged_run(_mk_batches(8, seed=2), workers=0)
+    s = ps.stats()
+    # paging H2D rides DeviceFeed.prepare on the dedicated "page" ring,
+    # so its transfers land in the shared stage accounting: the put
+    # stage accrues busy seconds and every prepared pair counts as a
+    # ring batch (the spans themselves carry the page:h2d name)
+    assert s.get("put", 0.0) > 0.0
+    assert s["batches"] > 0
+
+
+def test_paged_registry_export():
+    from wormhole_tpu.obs.metrics import Registry
+    ps = _paged_run(_mk_batches(8, seed=3), workers=0)
+    reg = Registry()
+    ps.to_registry(reg)
+    snap = reg.snapshot()
+    assert snap["page/pages_in"]["value"] > 0
+    assert snap["page/bytes_h2d"]["value"] > 0
+    assert 0.0 <= snap["page/hit_rate"]["value"] <= 1.0
+
+
+def test_paged_feed_rejects_undersized_window():
+    hot = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                       _mk_handle())
+    ps = PagedStore(hot, NB, late_window=4)
+    with pytest.raises(ValueError, match="lookahead bound"):
+        ps.feed(iter(()), workers=2, ring_depth=2)
+
+
+def test_paged_rejects_bad_cold_geometry():
+    hot = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                       _mk_handle())
+    with pytest.raises(ValueError, match="smaller than the hot"):
+        PagedStore(hot, HOT // 2)
+    with pytest.raises(ValueError, match="cold_init has"):
+        PagedStore(hot, NB, cold_init=np.zeros((NB - 1, 3), np.float32))
+
+
+def test_paged_from_config_wires_knobs():
+    from wormhole_tpu.utils.config import Config
+    cfg = Config(num_buckets=NB, hot_buckets=HOT, pipeline_workers=1,
+                 pipeline_ring=3, page_prefetch=4, page_chunk=32)
+    hot = ShardedStore(StoreConfig(num_buckets=HOT, loss="logit"),
+                       _mk_handle())
+    ps = PagedStore.from_config(cfg, hot)
+    assert ps.page_chunk == 32
+    assert ps.pager.late_window == late_window_for(1, 3, 4)
+    assert ps.nb_total == NB
+
+
+def test_with_num_buckets_twins():
+    full = ShardedStore(StoreConfig(num_buckets=NB, loss="logit"),
+                        _mk_handle())
+    hot = full.with_num_buckets(HOT)
+    assert hot.cfg.num_buckets == HOT
+    assert np.asarray(hot.slots).shape[0] == HOT
+    # the full-size twin's initial table seeds the cold tier exactly
+    ps = PagedStore(hot, NB, cold_init=np.asarray(full.slots))
+    assert ps.cold.shape[0] == NB
+
+
+# -- ledger routing -----------------------------------------------------
+
+def test_paging_spans_route_to_paging_bucket():
+    from wormhole_tpu.obs.ledger import BUCKETS, span_bucket
+    assert "paging" in BUCKETS
+    for name in ("page:h2d", "page:d2h", "page:evict"):
+        assert span_bucket(name) == "paging", name

@@ -231,6 +231,54 @@ def test_mesh_tile_step_large_nb_cap_floor():
     assert err < 2e-2, err
 
 
+def test_mesh_model_sharding_bitwise_vs_replicated():
+    """Bucket-space sharding over the model axis must be a pure layout
+    change: the same two blocks through a ``data:2,model:4`` mesh and
+    through a replicated ``data:2`` mesh (model axis absent) produce a
+    BITWISE-identical slot table at tau=0. nnz=1 makes the margin psum
+    over the model axis exact — each row's single pair lives on exactly
+    one model shard, so the reduction adds one finite term to zeros —
+    and per-bucket gradients never cross tile (hence shard) boundaries,
+    so no float reassociation is possible anywhere in the step."""
+    import jax
+    from wormhole_tpu.data.crec import CRec2Info
+    from wormhole_tpu.learners.handles import FTRLHandle, LearnRate
+    from wormhole_tpu.learners.store import ShardedStore, StoreConfig
+    from wormhole_tpu.ops.penalty import L1L2
+    from wormhole_tpu.parallel.mesh import MeshRuntime, make_mesh
+
+    rng = np.random.default_rng(23)
+    nb = 128 * tilemm.TILE
+    spec = tilemm.make_spec(nb, subblocks=1, cap=128)
+    info = CRec2Info(nnz=1, block_rows=spec.block_rows,
+                     total_rows=2 * spec.block_rows, nb=nb,
+                     subblocks=1, cap=spec.cap, ovf_cap=0)
+
+    blocks = {"pw": [], "labels": []}
+    for _ in range(2):
+        buckets, rows = make_pairs(rng, 8192, spec)
+        pw, ovb, _ = tilemm.encode_block(buckets, rows, spec)
+        assert not len(ovb)
+        blocks["pw"].append(pw)
+        blocks["labels"].append(
+            (rng.random(spec.block_rows) < 0.4).astype(np.uint8))
+    blocks = {k: np.stack(v) for k, v in blocks.items()}
+
+    def run(mesh_spec, ndev):
+        rt = MeshRuntime.create()
+        rt.mesh = make_mesh(mesh_spec, jax.devices()[:ndev])
+        handle = FTRLHandle(penalty=L1L2(0.1, 0.01),
+                            lr=LearnRate(0.5, 1.0))
+        store = ShardedStore(StoreConfig(num_buckets=nb, loss="logit"),
+                             handle, rt)
+        store.tile_train_step_mesh(blocks, info)
+        return np.asarray(jax.device_get(store.slots))
+
+    sharded = run("data:2,model:4", 8)
+    replicated = run("data:2", 2)
+    assert np.array_equal(sharded, replicated)
+
+
 def test_fused_tiles_match_unfused_and_oracle():
     """The K-tile fused bwd kernel (high-nb regime) must match the
     unfused kernels bit-for-bit (same bf16 arithmetic, same pairs — only

@@ -53,6 +53,15 @@ HOT_PATHS = {
     "wormhole_tpu/serve/forward.py": (
         "ForwardStep.predict",
     ),
+    # the bigmodel paging loop: tier moves run on the consumer thread
+    # between device steps, so an unmarked sync here stalls the step
+    # the paging was supposed to overlap
+    "wormhole_tpu/bigmodel/paged.py": (
+        "PagedStore.apply_plan",
+        "PagedStore._resolve_pending",
+        "PagedStore.flush",
+        "PagedStore.stage_fresh",
+    ),
 }
 
 _NP_NAMES = {"np", "numpy", "onp"}

@@ -66,6 +66,18 @@ SHARED_STATE = {
     "wormhole_tpu/data/pipeline.py": {
         "DeviceFeed": ("_busy", "_stall", "_batches", "_ring_max"),
     },
+    # bigmodel hot/cold tier: the residency map is single-writer on the
+    # feed dispatcher (seq_ctx); the cold table and pending writeback
+    # are consumer-owned; the byte counters are written from the
+    # transfer thread (stage_fresh) and the consumer (late fills)
+    "wormhole_tpu/bigmodel/pager.py": {
+        "BucketPager": ("slot_of", "bucket_of", "freq", "_free",
+                        "_last_evict", "_seq", "hits", "misses",
+                        "pages_in", "pages_out", "late_fills"),
+    },
+    "wormhole_tpu/bigmodel/paged.py": {
+        "PagedStore": ("cold", "_pending", "_bytes_h2d", "_bytes_d2h"),
+    },
 }
 
 _GUARDED_PAT = re.compile(r"#\s*guarded-by:\s*(\w+)")

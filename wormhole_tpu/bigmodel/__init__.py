@@ -1,0 +1,14 @@
+"""Billion-key model state: host-resident cold tier + device hot set.
+
+The reference scales past one machine's memory by sharding keys over
+parameter-server processes; this package scales past one chip's HBM by
+tiering — the full bucket space in host RAM, an LFU-managed working set
+on device, and all paging traffic moving through the DeviceFeed
+transfer ring so it overlaps the device step. See docs/bigmodel.md.
+"""
+
+from wormhole_tpu.bigmodel.pager import BucketPager, PagePlan, \
+    late_window_for
+from wormhole_tpu.bigmodel.paged import PagedStore
+
+__all__ = ["BucketPager", "PagePlan", "PagedStore", "late_window_for"]

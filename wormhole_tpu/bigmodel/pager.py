@@ -1,0 +1,214 @@
+"""Deterministic LFU bucket pager: the hot/cold split planner.
+
+The reference's headline run holds 800M unique features by sharding them
+over 100 ps-lite server *machines* — host RAM, not accelerator memory,
+bounds the model (PAPER.md §0). Our equivalent is a two-tier table: the
+full ``(nb_total, val_len)`` bucket space lives in host RAM (the cold
+tier) and a fixed ``hot_buckets``-row device table holds the working
+set. This module plans the tier moves; :mod:`.paged` executes them.
+
+The planner is PURE HOST STATE with one hard discipline: it runs on the
+``DeviceFeed`` dispatcher thread via ``seq_ctx`` — the pipeline's only
+sequential, in-stream-order stage — so plan ``i`` always sees exactly
+the residency state left by plans ``0..i-1`` no matter how many prep
+workers race downstream. That is what makes paging bit-reproducible at
+``workers=0`` vs ``workers=2`` (the determinism contract the tests
+pin): the hit/miss/victim sequence is a pure function of the key
+stream.
+
+Victim selection is LFU with a total order: among occupied slots not
+referenced by the current plan, evict the lowest ``(freq, slot)`` pair
+— frequency first, slot id as the deterministic tie break. The order is
+materialized as the composite integer ``freq * hot_buckets + slot``
+(unique per slot, so ``argpartition`` + a small sort of the selected
+prefix reproduce the full-lexsort sequence at O(candidates) instead of
+O(n log n) — the planner runs on the dispatcher's critical path, so on
+a host-starved machine this is the paged path's rate limiter).
+Frequencies are exact access counts, not decayed estimates, so two
+runs over the same stream produce identical eviction sequences.
+
+Late vs fresh fills — the one ordering hazard. A page-in reads the
+bucket's cold row; a page-out *writes* it, asynchronously (the D2H
+copy resolves at the next ``apply_plan``). When the dispatcher plans
+ahead of the consumer, a cold read racing an unresolved writeback of
+the same bucket would ship stale bytes. The pager closes the race
+structurally: a missed bucket whose last eviction was within
+``late_window`` plans is a **late** fill — its cold row is read on the
+consumer thread at apply time, after writeback resolution — while
+buckets idle longer than the window are **fresh** fills, staged
+through the transfer ring (safe: the window exceeds the pipeline's
+maximum dispatcher lead, so any writeback has resolved). The split
+never changes values, only *when* the identical bytes are read, so it
+cannot break determinism.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["PagePlan", "BucketPager", "late_window_for"]
+
+
+def late_window_for(workers: int, ring_depth: int, prefetch: int = 8) -> int:
+    """Upper bound (in plans) on how far the dispatcher can run ahead of
+    the consumer: the work queue (2·workers), the worker pool in flight,
+    the transfer thread's item, the ring, and the consumer's own item,
+    plus the ``page_prefetch`` slack knob. A fill inside this window is
+    'late' (cold row read at apply time)."""
+    w = max(int(workers), 0)
+    return 2 * w + w + int(ring_depth) + 2 + max(int(prefetch), 0)
+
+
+@dataclass
+class PagePlan:
+    """One block's residency plan, in stream order.
+
+    ``uniq``/``slots`` give the remap (sorted global bucket ids -> hot
+    slot ids) the prep stage applies to the batch; the miss/victim
+    arrays are the tier moves ``PagedStore.apply_plan`` executes. The
+    miss set is split into ``fresh`` (cold rows staged through the
+    transfer ring) and ``late`` (cold rows read at apply time — see the
+    module docstring for why both exist)."""
+
+    seq: int
+    uniq: np.ndarray          # int64 (u,) sorted unique global buckets
+    slots: np.ndarray         # int32 (u,) hot slot of uniq[i]
+    miss_buckets: np.ndarray  # int64 (m,) buckets paged in by this plan
+    miss_slots: np.ndarray    # int32 (m,) their assigned hot slots
+    victim_slots: np.ndarray  # int32 (e,) slots evicted to make room
+    victim_buckets: np.ndarray  # int64 (e,) the buckets those slots held
+    fresh: np.ndarray         # bool (m,) miss i staged through the ring
+    # filled by the feed: device rows for the fresh misses (staged on
+    # the transfer thread), or None when every fill is late/absent
+    staged_rows: object = None
+
+    @property
+    def late(self) -> np.ndarray:
+        return ~self.fresh
+
+
+class BucketPager:
+    """Residency map + LFU planner over ``nb_total`` buckets and
+    ``hot_buckets`` device slots. Single-writer: every method that
+    mutates state runs on the feed dispatcher thread (or the consumer
+    thread in the serial ``workers=0`` path — never both at once)."""
+
+    def __init__(self, nb_total: int, hot_buckets: int, *,
+                 late_window: int = 16) -> None:
+        if hot_buckets <= 0 or hot_buckets > nb_total:
+            raise ValueError(
+                f"hot_buckets {hot_buckets} must be in (0, {nb_total}]")
+        self.nb_total = int(nb_total)
+        self.hot_buckets = int(hot_buckets)
+        self.late_window = int(late_window)
+        # residency map; -1 = cold / free
+        self.slot_of = np.full(nb_total, -1, np.int64)  # owner-thread: feed-dispatch
+        self.bucket_of = np.full(hot_buckets, -1, np.int64)  # owner-thread: feed-dispatch
+        self.freq = np.zeros(hot_buckets, np.int64)  # owner-thread: feed-dispatch
+        self._free = hot_buckets  # owner-thread: feed-dispatch
+        # last plan seq that evicted each bucket; "never" is a sentinel
+        # far below any reachable seq so (seq - last) always clears the
+        # late window. An O(nb_total) array, but slot_of (and the cold
+        # tier itself) already scale the same way.
+        never = np.iinfo(np.int64).min // 2
+        self._last_evict = np.full(nb_total, never, np.int64)  # owner-thread: feed-dispatch
+        self._seq = 0  # owner-thread: feed-dispatch
+        # counters (read by stats() after the stream drains)
+        self.hits = 0  # owner-thread: feed-dispatch
+        self.misses = 0  # owner-thread: feed-dispatch
+        self.pages_in = 0  # owner-thread: feed-dispatch
+        self.pages_out = 0  # owner-thread: feed-dispatch
+        self.late_fills = 0  # owner-thread: feed-dispatch
+
+    def plan(self, buckets: np.ndarray) -> PagePlan:  # owner-thread: feed-dispatch
+        """Plan residency for one block's global bucket ids (any shape;
+        deduped and sorted here). Raises when the block needs more
+        unique buckets than the hot tier holds — a geometry error, not
+        a runtime condition to paper over."""
+        uniq = np.unique(np.asarray(buckets, np.int64))
+        if uniq.size > self.hot_buckets:
+            raise ValueError(
+                f"block touches {uniq.size} unique buckets but the hot "
+                f"tier holds {self.hot_buckets}; raise hot_buckets")
+        res = self.slot_of[uniq]
+        hit = res >= 0
+        hit_slots = res[hit]
+        self.freq[hit_slots] += 1
+        self.hits += int(hit.sum())
+
+        miss_b = uniq[~hit]
+        m = miss_b.size
+        self.misses += m
+        if m:
+            if self._free:
+                free = np.flatnonzero(self.bucket_of < 0)[:m]
+            else:
+                free = np.empty(0, np.int64)
+            need = m - free.size
+            if need > 0:
+                # LFU victims: occupied slots NOT referenced by this
+                # plan, lowest (freq, slot) first — a total order, so
+                # the eviction sequence is reproducible
+                cand = np.ones(self.hot_buckets, bool)
+                cand[hit_slots] = False
+                cand[free] = False
+                cand &= self.bucket_of >= 0
+                cs = np.flatnonzero(cand)
+                if cs.size < need:
+                    raise ValueError(
+                        f"plan {self._seq}: need {need} victims, only "
+                        f"{cs.size} evictable slots")
+                # composite (freq, slot) key — unique per slot, so the
+                # partition's selected SET and the prefix sort are both
+                # deterministic and identical to a full lexsort
+                comp = self.freq[cs] * self.hot_buckets + cs
+                if need < cs.size:
+                    part = np.argpartition(comp, need - 1)[:need]
+                    victims = cs[part[np.argsort(comp[part])]]
+                else:
+                    victims = cs[np.argsort(comp)]
+            else:
+                victims = np.empty(0, np.int64)
+            victim_buckets = self.bucket_of[victims]
+            self._last_evict[victim_buckets] = self._seq
+            self.slot_of[victim_buckets] = -1
+            miss_s = np.concatenate([free, victims]) if victims.size \
+                else free
+            self.slot_of[miss_b] = miss_s
+            self.bucket_of[miss_s] = miss_b
+            self.freq[miss_s] = 1
+            self._free = max(self._free - free.size, 0)
+            self.pages_in += m
+            self.pages_out += int(victims.size)
+            fresh = (self._seq - self._last_evict[miss_b]
+                     > self.late_window)
+            self.late_fills += int(m - fresh.sum())
+        else:
+            miss_s = np.empty(0, np.int64)
+            victims = np.empty(0, np.int64)
+            victim_buckets = np.empty(0, np.int64)
+            fresh = np.empty(0, bool)
+
+        plan = PagePlan(
+            seq=self._seq, uniq=uniq,
+            slots=self.slot_of[uniq].astype(np.int32),
+            miss_buckets=miss_b,
+            miss_slots=miss_s.astype(np.int32),
+            victim_slots=victims.astype(np.int32),
+            victim_buckets=victim_buckets.astype(np.int64),
+            fresh=fresh)
+        self._seq += 1
+        return plan
+
+    def resident_buckets(self) -> np.ndarray:
+        """Sorted global bucket ids currently in the hot tier."""
+        return np.sort(self.bucket_of[self.bucket_of >= 0])
+
+    def stats(self) -> dict:
+        total = max(self.hits + self.misses, 1)
+        return {"hits": self.hits, "misses": self.misses,
+                "pages_in": self.pages_in, "pages_out": self.pages_out,
+                "late_fills": self.late_fills,
+                "hit_rate": self.hits / total, "plans": self._seq}

@@ -175,18 +175,24 @@ class DeviceFeed:
 
     # -- stats ---------------------------------------------------------------
 
-    def _acc(self, table: dict, key: str, dt: float) -> None:
+    def _acc(self, table: dict, key: str, dt: float,
+             label: Optional[str] = None) -> None:
         with self._lock:
-            table[key] = table[key] + dt
+            table[key] = table.get(key, 0.0) + dt
         # every accounted interval doubles as a trace span on the thread
         # that did the work, so Perfetto shows dispatcher / prep pool /
         # transfer / consumer as separate tracks with stage overlap
         if trace.enabled():
             suffix = "_stall" if table is self._stall else ""
-            label = (self.prep_label
-                     if key == "prep" and self.prep_label else key)
-            trace.complete(f"{self.name}:{label}{suffix}",
-                           time.monotonic() - dt, dt, cat="feed")
+            if label is None:
+                label = (self.prep_label
+                         if key == "prep" and self.prep_label else key)
+            # a label carrying its own namespace (e.g. "page:h2d") IS
+            # the span name — it resolves through SPAN_TABLE directly
+            # instead of the <feed>:<stage> rule
+            name = (label if ":" in label
+                    else f"{self.name}:{label}{suffix}")
+            trace.complete(name, time.monotonic() - dt, dt, cat="feed")
 
     def stats(self) -> dict:
         """Snapshot: per-stage busy/stall seconds (worker seconds sum
@@ -241,7 +247,9 @@ class DeviceFeed:
         import jax
         return jax.device_put
 
-    def prepare(self, item: Any, ctx: Any = None):
+    def prepare(self, item: Any, ctx: Any = None, *,
+                prep_label: Optional[str] = None,
+                put_label: Optional[str] = None):
         """Run ONE item through prep + transfer inline and return the
         device-resident result — the pad/transfer machinery as a
         callable instead of a stream. The serving front-end drives the
@@ -249,15 +257,21 @@ class DeviceFeed:
         rather than being pulled from a source, so admission owns the
         loop and hands each flush group here for the same prep/put
         accounting (and trace spans) a streaming feed gets. No collate,
-        no on_close: one item in, one device item out."""
+        no on_close: one item in, one device item out.
+
+        ``prep_label``/``put_label`` rename the stage spans for callers
+        whose items are not ingest-shaped — the bigmodel pager routes
+        its page-row H2D transfers here with ``put_label="page:h2d"``
+        so paging reuses this one transfer path (stage accounting,
+        spans, batch count) instead of growing a second one."""
         mono = time.monotonic
         transfer = self._default_transfer()
         t0 = mono()
         res = self.prep(item, ctx) if self.prep else item
-        self._acc(self._busy, "prep", mono() - t0)
+        self._acc(self._busy, "prep", mono() - t0, label=prep_label)
         t0 = mono()
         out = transfer(res)
-        self._acc(self._busy, "put", mono() - t0)
+        self._acc(self._busy, "put", mono() - t0, label=put_label)
         with self._lock:
             self._batches += 1
         return out

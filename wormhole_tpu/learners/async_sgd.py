@@ -67,7 +67,8 @@ class AsyncSGD:
         (train_step/eval_step/nnz_weight/save_model) — the FM and wide&deep
         models plug in here with the same worker/scheduler pipeline."""
         self.cfg = cfg
-        self.rt = runtime or MeshRuntime.create(cfg.mesh_shape)
+        self.rt = runtime or MeshRuntime.create(
+            cfg.mesh_shape, getattr(cfg, "model_shards", 0))
         if store is None:
             lam = list(cfg.lambda_) + [0.0, 0.0]
             # config.proto:34-39 — L1: λ0·‖w‖₁ + ½λ1·‖w‖²; L2: ½λ0·‖w‖²

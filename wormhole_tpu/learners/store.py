@@ -382,6 +382,15 @@ class ShardedStore(TableCheckpoint):
         self._eval = self._build_eval()
         self.t = 1  # global update counter (SGD eta schedule)
 
+    def with_num_buckets(self, nb: int) -> "ShardedStore":
+        """A fresh store over the same config/handle/runtime at ``nb``
+        buckets — the hot-tier twin constructor the bigmodel pager uses
+        (bigmodel/paged.py) and the full-size oracle the paging parity
+        tests compare against."""
+        from dataclasses import replace
+        return ShardedStore(replace(self.cfg, num_buckets=nb),
+                            self.handle, self.rt)
+
     # -- jitted programs ----------------------------------------------------
 
     def _build_step(self):

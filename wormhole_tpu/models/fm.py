@@ -88,6 +88,14 @@ class FMStore(TableCheckpoint):
         self._eval = self._build_eval()
         self.t = 1
 
+    def with_num_buckets(self, nb: int) -> "FMStore":
+        """Same config/runtime at ``nb`` buckets (bigmodel hot-tier twin
+        / full-size parity oracle). The v init re-draws from cfg.seed
+        over the new bucket count — paged runs overwrite hot rows on
+        first touch, so only the COLD table's init matters for parity."""
+        from dataclasses import replace
+        return FMStore(replace(self.cfg, num_buckets=nb), self.rt)
+
     def _build_step(self):
         cfg = self.cfg
         k = cfg.dim

@@ -100,6 +100,14 @@ class WideDeepStore(TableCheckpoint):
         self._eval = self._build_eval()
         self.t = 1
 
+    def with_num_buckets(self, nb: int) -> "WideDeepStore":
+        """Same config/runtime at ``nb`` buckets (bigmodel hot-tier
+        twin / full-size parity oracle). The fresh MLP is discarded by
+        paged use — only the embedding table pages; callers wanting the
+        trained MLP copy ``mlp``/``mlp_accum`` across."""
+        from dataclasses import replace
+        return WideDeepStore(replace(self.cfg, num_buckets=nb), self.rt)
+
     def _forward(self, theta, mlp, batch: SparseBatch):
         w = theta[:, 0]
         v = theta[:, 1:]

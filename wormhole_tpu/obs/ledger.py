@@ -40,10 +40,14 @@ __all__ = ["SPAN_TABLE", "BUCKETS", "MXU_PASS_FLOOR_FRAC",
 
 # Ledger buckets. ``host_prep`` (parse/localize/pad) and ``other``
 # (checkpoint I/O, GBDT chunk reads) extend the core six so the step
-# loop's whole timeline lands somewhere nameable; ``unattributed`` is
-# computed, never declared.
+# loop's whole timeline lands somewhere nameable; ``paging`` isolates
+# bigmodel hot/cold tier traffic (bigmodel/paged.py) from the batch
+# H2D bucket — the whole point of the cold tier is that this bucket
+# stays small while nb outgrows HBM; ``unattributed`` is computed,
+# never declared.
 BUCKETS = ("encode", "h2d_transfer", "device_compute", "collective_wait",
-           "metrics_readback", "host_prep", "residual_stall", "other")
+           "metrics_readback", "host_prep", "residual_stall", "paging",
+           "other")
 
 # docs/perf.md: the tile kernels run at ~55-65% of the MXU-pass floor
 # (VPU one-hot builds + f32->bf16 conversion XLA won't overlap). The
@@ -122,6 +126,14 @@ SPAN_TABLE: Dict[str, str] = {
     # to the restored store (device pushes)
     "rejoin:handshake": "other",
     "rejoin:replay": "device_compute",
+    # bigmodel hot/cold tier paging (bigmodel/paged.py): page-row H2D
+    # staging (through DeviceFeed.prepare), the eviction gather +
+    # async-D2H dispatch, and the writeback-resolving host read. All
+    # three land in the dedicated paging bucket so tier traffic never
+    # masquerades as batch transfer or device compute.
+    "page:h2d": "paging",
+    "page:d2h": "paging",
+    "page:evict": "paging",
 }
 
 # DeviceFeed stage -> bucket, for dynamic ``<feed>:<stage>`` span names

@@ -43,6 +43,21 @@ def parse_mesh_shape(spec: str, num_devices: int) -> Tuple[Tuple[str, int], ...]
     return tuple(axes)
 
 
+def derive_mesh_shape(spec: str, model_shards: int = 0,
+                      num_devices: Optional[int] = None) -> str:
+    """Resolve the ``model_shards`` shorthand: with no explicit
+    ``mesh_shape``, a model axis of ``model_shards`` devices and a data
+    axis over the rest. An explicit spec always wins (the two knobs are
+    alternatives, not composable)."""
+    if spec or model_shards <= 1:
+        return spec
+    n = num_devices if num_devices is not None else len(jax.devices())
+    if n % model_shards:
+        raise ValueError(f"model_shards {model_shards} does not divide "
+                         f"{n} devices")
+    return f"{DATA_AXIS}:{n // model_shards},{MODEL_AXIS}:{model_shards}"
+
+
 def make_mesh(spec: str = "", devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     axes = parse_mesh_shape(spec, len(devices))
@@ -111,10 +126,12 @@ class MeshRuntime:
     mesh: Mesh
 
     @classmethod
-    def create(cls, mesh_spec: str = "") -> "MeshRuntime":
+    def create(cls, mesh_spec: str = "",
+               model_shards: int = 0) -> "MeshRuntime":
         ensure_platform()
         distributed_init()
-        return cls(mesh=make_mesh(mesh_spec))
+        return cls(mesh=make_mesh(
+            derive_mesh_shape(mesh_spec, model_shards)))
 
     @property
     def rank(self) -> int:

@@ -209,6 +209,25 @@ class Config:
                                 # max_nnz) for multi-host sync training,
                                 # where batch shapes must match across hosts
     mesh_shape: str = ""        # e.g. "data:4,model:2"; empty = all devices on "data"
+    # model-axis sharding shorthand: with mesh_shape empty, shard the
+    # (num_buckets,) slot planes over a "model" axis of this size and
+    # put the remaining devices on "data" (parallel/mesh.py
+    # derive_mesh_shape). 0/1 = no model axis; ignored when mesh_shape
+    # names axes explicitly.
+    model_shards: int = 0
+    # --- bigmodel hot/cold tiering (wormhole_tpu/bigmodel; see
+    # docs/bigmodel.md). Consumed by PagedStore.from_config and the
+    # bench bigmodel phase; 0 = whole table device-resident.
+    hot_buckets: int = 0     # on-device hot working set, in buckets,
+                             # backed by the full num_buckets cold table
+                             # in host RAM
+    page_prefetch: int = 8   # extra late-fill window slack (plans) on
+                             # top of the pipeline lookahead bound —
+                             # how much further a page-in may be staged
+                             # ahead through the transfer ring
+    page_chunk: int = 64     # padding quantum (rows) for paging
+                             # gather/scatter index vectors; bounds the
+                             # number of compiled paging programs
     cache_device: bool = False  # crec/crec2: keep streamed blocks resident in
                                 # HBM and replay them on later data passes
                                 # (dataset must fit device memory)

@@ -3,7 +3,8 @@ on real TPU hardware, checks them against the exact numpy oracle, and
 sweeps tiles_step. Not part of the bench; a dev tool.
 
 Usage: python scripts/ktune.py [reps] [tb1,tb2,...]
-       python scripts/ktune.py --kernel fused|split|both [reps]
+       python scripts/ktune.py --kernel fused|split|both \
+           [--windows N] [--burn N] [reps]
 
 ``--kernel`` times the full FTRL train step instead of the bare
 fwd/bwd pair; ``both`` is the A/B mode — each window times split and
@@ -136,6 +137,18 @@ def main():
             raise SystemExit(f"--kernel must be fused|split|both, "
                              f"got {kernel!r}")
         del args[i:i + 2]
+    # single-core hosts drive the fused kernel through interpret mode
+    # at ~10s/step — the TPU defaults (10 windows, 20-step burn) would
+    # run for the better part of an hour there
+    windows, burn = 10, 20
+    if "--windows" in args:
+        i = args.index("--windows")
+        windows = int(args[i + 1])
+        del args[i:i + 2]
+    if "--burn" in args:
+        i = args.index("--burn")
+        burn = int(args[i + 1])
+        del args[i:i + 2]
     reps = int(args[0]) if len(args) > 0 else 20
     tbs = ([int(x) for x in args[1].split(",")]
            if len(args) > 1 else [])
@@ -158,7 +171,7 @@ def main():
         # full-train-step A/B on the same encoded block; overflow pairs
         # are dropped from BOTH paths (the fused kernel is dense-only,
         # so the comparison stays operand-identical)
-        _kernel_ab(spec, pw, kernel, reps)
+        _kernel_ab(spec, pw, kernel, reps, windows=windows, burn=burn)
         return
 
     slots = spec.tiles * spec.subblocks * spec.cap

@@ -123,16 +123,26 @@ def test_fewer_than_two_runs_is_vacuous(tmp_path):
 
 
 def test_real_trajectory_with_injected_drop_fails(tmp_path):
-    """ISSUE acceptance: copy the real trajectory, halve the newest
-    run's headline -> nonzero exit."""
+    """ISSUE acceptance: copy the real trajectory, append a run whose
+    throughput keys are half the newest usable run's -> nonzero exit.
+    The injected run DERIVES from the real newest run so the test
+    tracks the trajectory as it grows (an earlier shape hardcoded the
+    newest run's name and went stale — and mutating an old run can't
+    work anyway: consecutive runs on different hosts deliberately
+    share no rate keys)."""
     d = str(tmp_path)
     names = sorted(n for n in os.listdir(REPO)
                    if n.startswith("BENCH_r") and n.endswith(".json"))
     for n in names:
         shutil.copy(os.path.join(REPO, n), os.path.join(d, n))
-    # newest usable run is r04: halve every throughput figure
-    p = os.path.join(d, "BENCH_r04.json")
-    doc = json.load(open(p))
+    newest = None
+    for n in reversed(names):
+        doc = json.load(open(os.path.join(d, n)))
+        if isinstance(doc.get("parsed"), dict) and doc.get("rc", 0) == 0:
+            newest = (n, doc)
+            break
+    assert newest is not None, "no usable run in the real trajectory"
+    name, doc = newest
 
     def halve(node):
         for k, v in list(node.items()):
@@ -143,8 +153,10 @@ def test_real_trajectory_with_injected_drop_fails(tmp_path):
                                     "rows_per_sec")):
                 node[k] = v / 2
     halve(doc["parsed"])
-    doc["parsed"]["value"] /= 2
-    json.dump(doc, open(p, "w"))
+    if isinstance(doc["parsed"].get("value"), (int, float)):
+        doc["parsed"]["value"] /= 2
+    nxt = int(name[len("BENCH_r"):-len(".json")]) + 1
+    json.dump(doc, open(os.path.join(d, f"BENCH_r{nxt:02d}.json"), "w"))
     r = _run("--dir", d)
     assert r.returncode == 1, r.stdout + r.stderr
     assert "regression" in r.stderr
@@ -503,5 +515,101 @@ def test_other_phase_h2d_keys_not_bigmodel_gated(tmp_path):
     d = str(tmp_path)
     _write_run(d, 1, _parsed(100_000.0,
                              {"e2e_stream": {"bytes_h2d": 0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _fleet(scaling=0.6, p99_x2=12.0, burn=0.0, cadence=15.1,
+           bytes_wire=520_000, q1=29_000.0, q4=17_000.0):
+    return {"serve_fleet": {
+        "slo_ms": 25.0, "capacity_qps": 35_000.0,
+        "scaling_1to4": scaling,
+        "sweep": {"r1": {"capacity_qps": 35_000.0, "qps_at_slo": q1,
+                         "p99_at_slo_ms": 7.4},
+                  "r4": {"capacity_qps": 20_000.0, "qps_at_slo": q4,
+                         "p99_at_slo_ms": 12.8}},
+        "overload": {"x2": {"offered_qps": 47_000.0,
+                            "achieved_qps": 43_000.0,
+                            "shed_frac": 0.08, "shed_storms": 1,
+                            "p99_ms": p99_x2, "burn": burn}},
+        "snapshot": {"versions": 10, "delta_frames": 8, "full_frames": 2,
+                     "bytes_wire": bytes_wire, "cadence_ratio": cadence,
+                     "full_ckpt_bytes": 786_485}}}
+
+
+def test_fleet_scaling_floor_gates_newest_run(tmp_path):
+    # a single usable run is enough for the absolute floor
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(scaling=0.2)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-fleet-scaling" in r.stderr
+    # the flag relaxes the floor, same machinery as the other absolutes
+    r2 = _run("--dir", d, "--min-fleet-scaling", "0.1")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_fleet_snapshot_plane_gates(tmp_path):
+    """The ISSUE acceptance gates on the snapshot plane: real wire
+    bytes, and delta shipping beating full-checkpoint polling by the
+    --min-snapshot-ratio floor at the same freshness cadence."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(bytes_wire=0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "shipped no measured bytes" in r.stderr
+    _write_run(d, 1, _parsed(100_000.0, _fleet(cadence=1.2)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-snapshot-ratio" in r.stderr
+    r2 = _run("--dir", d, "--min-snapshot-ratio", "1.0")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_fleet_overload_p99_gated_against_runs_own_slo(tmp_path):
+    # the 2x-overload p99 is gated against the run's OWN slo_ms — the
+    # whole point of shedding is holding that number under overload
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(p99_x2=40.0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "broke the SLO at 2x overload" in r.stderr
+    _write_run(d, 1, _parsed(100_000.0, _fleet(p99_x2=24.0)))
+    assert _run("--dir", d).returncode == 0
+
+
+def test_fleet_burn_gated_under_slo_flag_only(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(burn=5.0)))
+    # without --slo the burn number is informational
+    assert _run("--dir", d).returncode == 0
+    r = _run("--dir", d, "--slo")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "serve_fleet.overload.x2.burn" in r.stderr
+    # healthy burn passes under --slo
+    _write_run(d, 1, _parsed(100_000.0, _fleet(burn=0.0)))
+    assert _run("--dir", d, "--slo").returncode == 0
+
+
+def test_fleet_qps_at_slo_trend_rides_tol(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(q1=29_000.0)))
+    _write_run(d, 2, _parsed(100_000.0, _fleet(q1=14_000.0)))  # halved
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "qps-at-SLO regression" in r.stderr
+    # within --tol the same pair passes
+    r2 = _run("--dir", d, "--tol", "0.6")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_fleet_latency_keys_excluded_from_trend(tmp_path):
+    """serve_fleet p99 keys jitter past any useful --tol on sub-second
+    CPU stages (measured >2x run to run at the same offered rate); they
+    are gated by the ABSOLUTE SLO ceiling instead, so a 4x wobble that
+    stays under slo_ms must not trip the pairwise latency trend."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _fleet(p99_x2=5.0)))
+    _write_run(d, 2, _parsed(100_000.0, _fleet(p99_x2=20.0)))
     r = _run("--dir", d)
     assert r.returncode == 0, r.stdout + r.stderr

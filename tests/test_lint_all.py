@@ -101,6 +101,11 @@ def test_findings_fail_with_code_and_location(tmp_path):
     (pkg / "serve").mkdir(parents=True)
     (pkg / "serve" / "oops.py").write_text(
         "from wormhole_tpu.learners import train_step\n")
+    # satisfy the serve checker's lossy-allowlist rule so the one
+    # finding below stays the only one
+    (pkg / "parallel").mkdir()
+    (pkg / "parallel" / "filters.py").write_text(
+        'DEFAULT_LOSSY_SITES = {\n    "serve/snapshot",\n}\n')
     r = _run("--root", str(tmp_path), "--only", "serve")
     assert r.returncode == 1
     assert "WH-SERVE wormhole_tpu/serve/oops.py:1:" in r.stderr

@@ -1,8 +1,16 @@
-"""The serve lint (scripts/lint_serve.py) enforces the pull-only
-contract of PR 7: nothing under wormhole_tpu/serve/ may reach a
-push/update/optimizer entry point or scatter into a parameter table.
-The real package must pass; synthetic violations of each forbidden
-pattern class must fail with file:line diagnostics."""
+"""The serve lint (scripts/lint_serve.py) enforces two contracts:
+
+- pull-only (PR 7): nothing under wormhole_tpu/serve/ may reach a
+  push/update/optimizer entry point or scatter into a parameter table
+  — the rule scopes to the whole package, so fleet.py/router.py are
+  covered automatically;
+- lossy-allowlist single declaration (PR 17): DEFAULT_LOSSY_SITES is
+  declared exactly once, in wormhole_tpu/parallel/filters.py, and that
+  declaration carries the 'serve/snapshot' site the fleet's delta
+  publisher encodes through.
+
+The real package must pass; synthetic violations of each class must
+fail with file:line diagnostics."""
 
 import os
 import subprocess
@@ -11,10 +19,24 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "lint_serve.py")
 
+_FILTERS_OK = 'DEFAULT_LOSSY_SITES = {\n    "serve/snapshot",\n}\n'
+
 
 def _run(*args):
     return subprocess.run([sys.executable, SCRIPT, *args],
                           capture_output=True, text=True)
+
+
+def _mk_tree(tmp_path, filters_src=_FILTERS_OK):
+    """Minimal scannable tree: a serve package plus the allowlist
+    declaration the single-source rule expects."""
+    pkg = tmp_path / "wormhole_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    par = tmp_path / "wormhole_tpu" / "parallel"
+    par.mkdir()
+    if filters_src is not None:
+        (par / "filters.py").write_text(filters_src)
+    return pkg
 
 
 def test_repo_serve_package_is_pull_only():
@@ -22,6 +44,7 @@ def test_repo_serve_package_is_pull_only():
     assert r.returncode == 0, r.stderr
     assert "OK" in r.stdout
     assert "pull-only" in r.stdout
+    assert "single-sourced" in r.stdout
 
 
 def test_missing_package_is_distinct_rc(tmp_path):
@@ -30,8 +53,7 @@ def test_missing_package_is_distinct_rc(tmp_path):
 
 
 def test_push_call_caught(tmp_path):
-    pkg = tmp_path / "wormhole_tpu" / "serve"
-    pkg.mkdir(parents=True)
+    pkg = _mk_tree(tmp_path)
     (pkg / "bad.py").write_text(
         "def f(store, slots, grad, t, tau):\n"
         "    # a comment saying .push( must NOT trip the lint\n"
@@ -43,8 +65,7 @@ def test_push_call_caught(tmp_path):
 
 
 def test_train_step_and_scatter_caught(tmp_path):
-    pkg = tmp_path / "wormhole_tpu" / "serve"
-    pkg.mkdir(parents=True)
+    pkg = _mk_tree(tmp_path)
     (pkg / "bad.py").write_text(
         "def f(store, batch, x, i, v):\n"
         "    m = store.train_step(batch)\n"
@@ -57,9 +78,24 @@ def test_train_step_and_scatter_caught(tmp_path):
     assert "wormhole_tpu/serve/bad.py:3" in r.stderr   # multiline scatter
 
 
+def test_fleet_and_router_files_covered(tmp_path):
+    """The pull-only scope is the whole package: a push reached from
+    fleet.py or router.py fails exactly like one from frontend.py."""
+    pkg = _mk_tree(tmp_path)
+    (pkg / "fleet.py").write_text(
+        "def publish_frame(handle, slots, grad, t, tau):\n"
+        "    return handle.push(slots, grad, t, tau)\n")
+    (pkg / "router.py").write_text(
+        "def rebalance(store, batch):\n"
+        "    return store.train_step(batch)\n")
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "wormhole_tpu/serve/fleet.py:2" in r.stderr
+    assert "wormhole_tpu/serve/router.py:2" in r.stderr
+
+
 def test_pull_only_code_passes(tmp_path):
-    pkg = tmp_path / "wormhole_tpu" / "serve"
-    pkg.mkdir(parents=True)
+    pkg = _mk_tree(tmp_path)
     (pkg / "fine.py").write_text(
         "def f(store, params, batch):\n"
         "    # pull + margin + a benign .set (not a scatter-add)\n"
@@ -73,11 +109,49 @@ def test_pull_only_code_passes(tmp_path):
 
 def test_files_outside_serve_not_scanned(tmp_path):
     # the training stores legitimately push; the lint's scope is serve/
+    _mk_tree(tmp_path)
     pkg = tmp_path / "wormhole_tpu"
-    (pkg / "serve").mkdir(parents=True)
     (pkg / "learners").mkdir()
     (pkg / "learners" / "store.py").write_text(
         "def f(h, s, g, t, tau):\n"
         "    return h.push(s, g, t, tau)\n")
     r = _run("--root", str(tmp_path))
     assert r.returncode == 0, r.stderr
+
+
+# -- lossy-allowlist single declaration ----------------------------------
+
+
+def test_missing_allowlist_declaration_fails(tmp_path):
+    _mk_tree(tmp_path, filters_src=None)
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "DEFAULT_LOSSY_SITES" in r.stderr
+
+
+def test_allowlist_missing_serve_snapshot_site_fails(tmp_path):
+    _mk_tree(tmp_path,
+             filters_src='DEFAULT_LOSSY_SITES = {\n    "ps/delta",\n}\n')
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "serve/snapshot" in r.stderr
+
+
+def test_duplicate_allowlist_declaration_fails(tmp_path):
+    pkg = _mk_tree(tmp_path)
+    # a serve-side fork of the allowlist: exactly the drift the
+    # single-source rule exists to stop
+    (pkg / "fleet.py").write_text(
+        'DEFAULT_LOSSY_SITES = {"serve/snapshot", "serve/extra"}\n')
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "duplicate DEFAULT_LOSSY_SITES" in r.stderr
+
+
+def test_allowlist_declared_outside_home_fails(tmp_path):
+    _mk_tree(tmp_path, filters_src=None)
+    (tmp_path / "wormhole_tpu" / "serve" / "fleet.py").write_text(
+        'DEFAULT_LOSSY_SITES = {"serve/snapshot"}\n')
+    r = _run("--root", str(tmp_path))
+    assert r.returncode == 1
+    assert "outside its home" in r.stderr

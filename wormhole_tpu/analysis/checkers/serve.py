@@ -1,11 +1,21 @@
 """WH-SERVE: nothing under wormhole_tpu/serve/ touches training entry
-points.
+points, and the lossy-site allowlist stays single-sourced.
 
 Migrated from ``scripts/lint_serve.py`` (now a shim over this module).
 The serving tier is PULL-ONLY: it reads model snapshots and computes
 margins; it never updates parameters, never touches optimizer state,
 never scatters into a table — a serve-side write would race the
-training loop and tear the swap's one-consistent-model guarantee.
+training loop and tear the swap's one-consistent-model guarantee. The
+rule covers every file under the package, fleet.py/router.py included.
+
+Second contract: ``DEFAULT_LOSSY_SITES`` — the allowlist deciding which
+exchange sites may quantize — is declared at EXACTLY ONE site
+(``wormhole_tpu/parallel/filters.py``), and that declaration carries
+the ``serve/snapshot`` site the fleet's delta publisher encodes
+through. A second declaration (or a fork of the set in serve code)
+would let lossy semantics drift per call site; a missing
+``serve/snapshot`` entry would silently ship snapshot deltas exact,
+quietly losing the wire-ratio the fleet bench gates on.
 """
 
 from __future__ import annotations
@@ -44,6 +54,15 @@ _strip_comments = strip_comments
 
 _SCOPE = "wormhole_tpu/serve/"
 
+# the one file allowed to declare the lossy-site allowlist, and the
+# serve-fleet site that declaration must carry
+_LOSSY_HOME = "wormhole_tpu/parallel/filters.py"
+_LOSSY_REQUIRED_SITE = "serve/snapshot"
+# a module-level (column-0) assignment of the allowlist, annotated or
+# not; attribute reads and set() copies of the name don't match
+_LOSSY_DECL = re.compile(
+    r"(?m)^DEFAULT_LOSSY_SITES\s*(?::[^=\n]+)?=\s*\{(?P<body>[^}]*)\}")
+
 
 def _scan_text(code: str) -> list:
     out = []
@@ -67,6 +86,8 @@ class ServeChecker(Checker):
         super().__init__(root)
         self.violations: list = []   # "rel:line: reason"
         self.nfiles = 0
+        # (rel, line, body) per DEFAULT_LOSSY_SITES declaration found
+        self.lossy_decls: list = []
 
     def precheck(self):
         if not os.path.isdir(os.path.join(self.root, "wormhole_tpu",
@@ -76,6 +97,11 @@ class ServeChecker(Checker):
         return None
 
     def visit(self, ctx: FileContext) -> None:
+        if (ctx.rel.endswith(".py")
+                and "DEFAULT_LOSSY_SITES" in ctx.code):
+            for m in _LOSSY_DECL.finditer(ctx.code):
+                ln = ctx.code.count("\n", 0, m.start()) + 1
+                self.lossy_decls.append((ctx.rel, ln, m.group("body")))
         if not ctx.rel.startswith(_SCOPE):
             return
         self.nfiles += 1
@@ -85,8 +111,41 @@ class ServeChecker(Checker):
                         f"serve/ is pull-only but reaches a training "
                         f"mutation entry point: {reason}")
 
+    def finish(self) -> None:
+        bad = []
+        if not self.lossy_decls:
+            bad.append((_LOSSY_HOME, None,
+                        "DEFAULT_LOSSY_SITES declaration not found — "
+                        "the lossy-site allowlist must be declared "
+                        f"exactly once, in {_LOSSY_HOME}"))
+        elif len(self.lossy_decls) > 1:
+            sites = ", ".join(f"{r}:{ln}" for r, ln, _ in self.lossy_decls)
+            for rel, ln, _ in self.lossy_decls[1:]:
+                bad.append((rel, ln,
+                            f"duplicate DEFAULT_LOSSY_SITES declaration "
+                            f"({sites}) — the lossy allowlist is "
+                            f"single-sourced in {_LOSSY_HOME}; forking "
+                            f"it lets lossy semantics drift per site"))
+        else:
+            rel, ln, body = self.lossy_decls[0]
+            if rel != _LOSSY_HOME:
+                bad.append((rel, ln,
+                            f"DEFAULT_LOSSY_SITES declared outside its "
+                            f"home {_LOSSY_HOME}"))
+            if (f'"{_LOSSY_REQUIRED_SITE}"' not in body
+                    and f"'{_LOSSY_REQUIRED_SITE}'" not in body):
+                bad.append((rel, ln,
+                            f"DEFAULT_LOSSY_SITES is missing the "
+                            f"{_LOSSY_REQUIRED_SITE!r} site — without "
+                            f"it the serve fleet ships snapshot deltas "
+                            f"exact and the quant wire ratio collapses"))
+        for rel, ln, msg in bad:
+            self.violations.append(f"{rel}:{ln or 0}: {msg}")
+            self.report(rel, ln, msg)
+
     def ok_line(self) -> str:
-        return f"{self.name}: OK ({self.nfiles} serve files pull-only)"
+        return (f"{self.name}: OK ({self.nfiles} serve files pull-only; "
+                f"lossy allowlist single-sourced)")
 
     # -- legacy shim surface -------------------------------------------
 
@@ -94,18 +153,19 @@ class ServeChecker(Checker):
         out = out or sys.stdout
         err = err or sys.stderr
         if self.violations:
-            print("lint_serve: serving code reaching a training "
-                  "mutation entry point (serve/ is pull-only):",
+            print("lint_serve: serve-contract violations (pull-only "
+                  "rule / lossy-allowlist single declaration):",
                   file=err)
             for v in self.violations:
                 print(f"  {v}", file=err)
             print("serving must never push/update/scatter — if the "
                   "feature needs writes, it belongs in learners/ "
-                  "behind the store API, not under wormhole_tpu/serve/",
-                  file=err)
+                  "behind the store API, not under wormhole_tpu/serve/; "
+                  "and DEFAULT_LOSSY_SITES lives only in "
+                  f"{_LOSSY_HOME}", file=err)
             return 1
-        print(f"lint_serve: OK ({self.nfiles} serve files pull-only)",
-              file=out)
+        print(f"lint_serve: OK ({self.nfiles} serve files pull-only; "
+              f"lossy allowlist single-sourced)", file=out)
         return 0
 
 

@@ -80,6 +80,10 @@ DEFAULT_LOSSY_SITES: Set[str] = {
     "hier/delta",         # hierarchical transport: host-level bucket
                           # deltas on the cross-host leg (the in-mesh
                           # ICI psum below them stays exact)
+    "serve/snapshot",     # serve/fleet.py: publisher->replica model
+                          # deltas (base-version-tagged frames; full
+                          # resyncs ride the same site with op="bcast"
+                          # and therefore stay exact)
 }
 
 _FLAG_QUANT = 1

@@ -509,7 +509,15 @@ def _base_exchange(ex: Exchange):
             leaves, treedef = jax.tree.flatten(ex.tree)
             out = []
             for i, x in enumerate(leaves):
-                buf = (ex.chain.encode_leaf(ex.site, i, x, "bcast")
+                # The broadcast op is the CODEC op: "bcast" stays exact
+                # (zlib only), while publishers that fan out residual
+                # deltas pass op="sum" so the chain's lossy gate
+                # (quant8 + error feedback on allowlisted sites) applies
+                # to the one encode the root performs. Every receiver —
+                # root included — decodes the same wire bytes, so the
+                # return value is bitwise identical fleet-wide and the
+                # root can adopt it as the new shipped base.
+                buf = (ex.chain.encode_leaf(ex.site, i, x, ex.op)
                        if src else b"")
                 out.append(ex.chain.decode_leaf(
                     ex.site, i, wire.bcast_bytes(buf, ex.root)))
@@ -565,8 +573,14 @@ class TransportStack:
                                      mesh=mesh))
 
     def broadcast(self, tree, mesh=None, root: int = 0,
-                  site: Optional[str] = None):
-        return self.execute(Exchange("broadcast", tree, root=root,
+                  site: Optional[str] = None, op: str = "bcast"):
+        """One-to-all. ``op`` selects the codec path: the default
+        ``"bcast"`` is exact end-to-end; ``op="sum"`` routes the root's
+        encode through the chain's lossy gate, which fires only on
+        allowlisted sites — how the serve fleet ships quantized
+        snapshot deltas (site ``serve/snapshot``) while every other
+        broadcast stays bit-exact."""
+        return self.execute(Exchange("broadcast", tree, op=op, root=root,
                                      site=site, mesh=mesh))
 
     # -- non-layered wire passthroughs -------------------------------

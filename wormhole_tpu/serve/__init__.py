@@ -18,7 +18,15 @@ equivalent lives here:
   for a new version, load into a standby pytree with identical avals,
   swap atomically between batches (zero recompiles, no torn reads),
   plus a :class:`~.snapshot.ServeRunner` that co-schedules serving
-  against a live training loop on the same chip.
+  against a live training loop on the same chip;
+- :mod:`.router` — shared-nothing request routing: consistent-hash
+  over a virtual-node ring with an optional least-loaded spill valve
+  fed by the replicas' queue-depth gauges;
+- :mod:`.fleet` — N replicas behind the router, kept fresh by ONE
+  snapshot publisher fanning base-version-tagged delta frames through
+  the transport layer (site ``serve/snapshot``, quant8+EF+zlib for
+  deltas, exact full frames on cadence or version gap) instead of N
+  independent disk polls.
 
 The pull-only contract — nothing under this package may call a
 push/update/optimizer entry point — is enforced statically by
@@ -27,9 +35,15 @@ push/update/optimizer entry point — is enforced statically by
 
 from __future__ import annotations
 
+from .fleet import ServeFleet, SnapshotPublisher, SnapshotSubscriber
 from .forward import ForwardStep
-from .frontend import ServeFrontend, serve_metrics
-from .snapshot import SnapshotPoller, ServeRunner
+from .frontend import (ServeFrontend, ServeShedError, ShedPolicy,
+                       serve_metrics, shed_metrics)
+from .router import Router, request_key
+from .snapshot import ServeRunner, SnapshotPoller, snapshot_metrics
 
-__all__ = ["ForwardStep", "ServeFrontend", "serve_metrics",
-           "SnapshotPoller", "ServeRunner"]
+__all__ = ["ForwardStep", "ServeFrontend", "ServeShedError",
+           "ShedPolicy", "serve_metrics", "shed_metrics",
+           "SnapshotPoller", "ServeRunner", "snapshot_metrics",
+           "Router", "request_key", "ServeFleet", "SnapshotPublisher",
+           "SnapshotSubscriber"]

@@ -20,26 +20,45 @@ latency (admission wait + flush + forward) feeds the ``serve/*``
 metrics through the obs registry; p50/p99 come from an exact reservoir
 of recent latencies (the registry histogram's fixed buckets are for
 export/merge, too coarse for a tail gate).
+
+Admission is priority-aware: ``submit(..., priority=p)`` files the
+request under class ``p`` (0 = interactive, higher = more sheddable;
+class 0 is never shed). Batches serve classes in priority order, FIFO
+within a class. Under overload a :class:`ShedPolicy` drops the OLDEST
+request of the LOWEST class whenever the projected queue wait exceeds
+the deadline — but only once the rolling p99 has climbed into the SLO
+ceiling's engagement band (``engage_frac * objective.bound``), so a
+transient burst that the deadline flush can absorb is never shed, and
+shedding starts BEFORE the ceiling objective begins burning its error
+budget. Shed requests fail fast with :class:`ServeShedError` (the
+client can retry against another replica or degrade gracefully);
+``serve/shed`` counts them, and a shed storm (``storm_n`` sheds inside
+``storm_window_s``) triggers one FlightRecorder dump so the minutes
+around the overload are preserved for postmortem.
 """
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
 from collections import deque
-from typing import Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence
 
 import numpy as np
 
 from wormhole_tpu.data.feed import SparseBatch, next_bucket
 from wormhole_tpu.data.localizer import localize_bucket_grid
 from wormhole_tpu.obs import trace
+from wormhole_tpu.obs import flight as _flight
 from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("serve")
 
-__all__ = ["ServeFrontend", "ServeResult", "serve_metrics"]
+__all__ = ["ServeFrontend", "ServeResult", "ServeShedError",
+           "ShedPolicy", "serve_metrics", "shed_metrics"]
 
 # exact-latency reservoir depth for the p50/p99 the bench gates on
 _LAT_WINDOW = 1 << 16
@@ -69,21 +88,70 @@ def serve_metrics(reg):
                            "flush time", agg="max"))
 
 
+def shed_metrics(reg):
+    """Single declaration site for the load-shedding counters:
+    (requests shed, storm dumps triggered)."""
+    return (reg.counter("serve/shed",
+                        help="requests dropped by deadline-aware load "
+                             "shedding (failed fast with "
+                             "ServeShedError)"),
+            reg.counter("serve/shed_storms",
+                        help="shed storms detected (storm_n sheds "
+                             "inside storm_window_s; one FlightRecorder "
+                             "dump each)"))
+
+
 # min seconds between rolling-p99 recomputations on the flush path —
-# a percentile over the 64Ki reservoir is ~ms, too dear per flush
-_P99_REFRESH_S = 0.5
+# a percentile over the reservoir is too dear per flush, but the value
+# is also the shed controller's feedback delay: at 0.5s the band
+# re-arms half a second after a backlog starts climbing, which at
+# 10k+ qps is thousands of queued requests of overshoot (measured as
+# a 2-3x p99 sawtooth under sustained overload)
+_P99_REFRESH_S = 0.1
+
+
+class ServeShedError(RuntimeError):
+    """The admission queue dropped this request under overload."""
+
+
+@dataclass(frozen=True)
+class ShedPolicy:
+    """Deadline-aware load shedding, armed by an SLO ceiling.
+
+    ``objective`` is an ``obs.slo.Objective`` ceiling on
+    ``serve/p99_ms`` (or None to arm purely on projected wait);
+    shedding engages once the rolling p99 reaches ``engage_frac *
+    objective.bound`` — inside the band where the next few seconds of
+    queue growth would start burning the objective's error budget, but
+    before the ceiling itself is crossed — and STAYS engaged for
+    ``hold_s`` after the band last fired. The hold is hysteresis
+    against flapping: successful shedding immediately pulls the rolling
+    p99 back under the band, and without it the controller disarms
+    mid-overload, lets the backlog regrow for a full feedback delay,
+    and serves that overshoot as a latency sawtooth. ``storm_n`` sheds
+    within ``storm_window_s`` is a storm: one FlightRecorder dump
+    (deduped by the recorder) captures the telemetry window around
+    it."""
+
+    objective: object = None
+    engage_frac: float = 0.8
+    hold_s: float = 0.5
+    storm_n: int = 64
+    storm_window_s: float = 5.0
 
 
 class ServeResult:
     """Future for one submitted request; resolved at batch flush."""
 
-    __slots__ = ("keys", "vals", "t0", "_event", "margin", "pred", "_err")
+    __slots__ = ("keys", "vals", "t0", "priority", "_event", "margin",
+                 "pred", "_err")
 
     def __init__(self, keys: np.ndarray, vals: np.ndarray,
-                 t0: float) -> None:
+                 t0: float, priority: int = 0) -> None:
         self.keys = keys
         self.vals = vals
         self.t0 = t0
+        self.priority = priority
         self._event = threading.Event()
         self.margin: Optional[float] = None
         self.pred: Optional[float] = None
@@ -124,6 +192,7 @@ class ServeFrontend:
     def __init__(self, forward, *, batch_rows: int = 256,
                  max_nnz: int = 64, key_pad: int = 0,
                  deadline_ms: float = 5.0, registry=None,
+                 shed: Optional[ShedPolicy] = None,
                  name: str = "serve") -> None:
         from wormhole_tpu.data.pipeline import DeviceFeed
         self.forward = forward
@@ -132,6 +201,7 @@ class ServeFrontend:
         self.key_pad = int(key_pad) or next_bucket(
             self.batch_rows * self.max_nnz, 64)
         self.deadline_s = float(deadline_ms) / 1e3
+        self.shed = shed
         self.name = name
         # the ingest pad/transfer machinery, driven in reverse: prepare()
         # runs prep (group -> padded SparseBatch) + device put with the
@@ -140,18 +210,29 @@ class ServeFrontend:
                                 name=name)
         self._q: "queue.Queue" = queue.Queue()
         self._metrics = None
+        self._shed_metrics = None
         if registry is not None:
             self._metrics = serve_metrics(registry)
+            self._shed_metrics = shed_metrics(registry)
         # Flush-thread counters read by stats() from client threads;
         # both sides take _lock around every touch.
         self._lat: deque = deque(maxlen=_LAT_WINDOW)  # guarded-by: _lock
         self._p99_next = 0.0          # next rolling-p99 refresh (mono)
+        self._p99_last = 0.0          # last rolling p99 ms  guarded-by: _lock
         self._lock = threading.Lock()
         self._requests = 0  # guarded-by: _lock
         self._batches = 0  # guarded-by: _lock
         self._deadline_flushes = 0  # guarded-by: _lock
         self._full_flushes = 0  # guarded-by: _lock
         self._depth_max = 0  # guarded-by: _lock
+        self._shed_total = 0  # guarded-by: _lock
+        self._shed_storms = 0  # guarded-by: _lock
+        self._pending_n = 0  # loop-owned backlog size  guarded-by: _lock
+        # EWMA of one flush's wall time (prepare + forward), the service
+        # rate behind the projected-wait shed decision
+        self._ewma_flush_s = 0.0  # owner-thread: serve-flush
+        self._armed_until = 0.0   # owner-thread: serve-flush
+        self._shed_times: deque = deque()  # owner-thread: serve-flush
         self._trunc_warned = False
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -161,11 +242,16 @@ class ServeFrontend:
     # -- client surface ------------------------------------------------------
 
     def submit(self, keys: Sequence[int],
-               vals: Optional[Sequence[float]] = None) -> ServeResult:
+               vals: Optional[Sequence[float]] = None,
+               priority: int = 0) -> ServeResult:
         """Enqueue one request (global bucket ids + optional values;
-        binary features default to 1.0). Returns a ServeResult future."""
+        binary features default to 1.0). ``priority`` 0 is interactive
+        (never shed); higher classes are sheddable, lowest class first.
+        Returns a ServeResult future."""
         if self._closed:
             raise RuntimeError("serve frontend is closed")
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
         keys = np.asarray(keys, np.int64).ravel()
         if vals is None:
             vals = np.ones(keys.shape, np.float32)
@@ -174,9 +260,17 @@ class ServeFrontend:
             if vals.shape != keys.shape:
                 raise ValueError(
                     f"vals shape {vals.shape} != keys {keys.shape}")
-        req = ServeResult(keys, vals, time.monotonic())
+        req = ServeResult(keys, vals, time.monotonic(), int(priority))
         self._q.put(req)
         return req
+
+    def queue_depth(self) -> int:
+        """Live backlog estimate: arrivals not yet drained plus the
+        flush loop's pending classes — the per-replica depth gauge the
+        fleet router's spill policy reads."""
+        with self._lock:
+            pending = self._pending_n
+        return self._q.qsize() + pending
 
     def close(self) -> None:
         """Stop admitting, flush everything pending, join the loop."""
@@ -186,15 +280,25 @@ class ServeFrontend:
         self._q.put(_CLOSE)
         self._thread.join()
 
+    def latencies_s(self) -> np.ndarray:
+        """Copy of the per-request latency window (seconds). Lets a
+        fleet merge reservoirs for honest aggregate percentiles instead
+        of averaging per-replica p99s."""
+        with self._lock:
+            return np.asarray(self._lat, np.float64)
+
     def stats(self) -> dict:
         """Snapshot: request/batch counts, flush-cause split, queue
-        high-water mark, exact p50/p99 ms over the latency window."""
+        high-water mark, shed totals, exact p50/p99 ms over the latency
+        window."""
         with self._lock:
             lat = np.asarray(self._lat, np.float64)
             out = {"requests": self._requests, "batches": self._batches,
                    "deadline_flushes": self._deadline_flushes,
                    "full_flushes": self._full_flushes,
-                   "queue_depth_max": self._depth_max}
+                   "queue_depth_max": self._depth_max,
+                   "shed": self._shed_total,
+                   "shed_storms": self._shed_storms}
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50) * 1e3)
             out["p99_ms"] = float(np.percentile(lat, 99) * 1e3)
@@ -203,23 +307,37 @@ class ServeFrontend:
     # -- flush loop ----------------------------------------------------------
 
     def _loop(self) -> None:
-        while True:
-            try:
-                first = self._q.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            if first is _CLOSE:
-                break
-            group = [first]
-            closing = False
+        # priority class -> FIFO of admitted-but-unflushed requests.
+        # Loop-owned; only the backlog SIZE is shared (via _pending_n).
+        pending: dict = {}
+        npend = 0
+
+        def admit(req) -> int:
+            pending.setdefault(req.priority, deque()).append(req)
+            return npend + 1
+
+        def set_pending(n: int) -> None:
+            with self._lock:
+                self._pending_n = n
+
+        closing = False
+        while not closing:
+            if npend == 0:
+                try:
+                    first = self._q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if first is _CLOSE:
+                    break
+                npend = admit(first)
             # admit until full OR the oldest request's deadline fires.
             # The deadline bounds waiting for NEW arrivals only: under
             # backlog (deadline already past at dequeue) the queue is
             # drained non-blocking into full batches — flushing
             # singletons there would collapse throughput exactly when
             # batching matters most
-            deadline = first.t0 + self.deadline_s
-            while len(group) < self.batch_rows:
+            deadline = self._oldest_t0(pending) + self.deadline_s
+            while npend < self.batch_rows:
                 wait = deadline - time.monotonic()
                 try:
                     nxt = (self._q.get_nowait() if wait <= 0
@@ -229,25 +347,143 @@ class ServeFrontend:
                 if nxt is _CLOSE:
                     closing = True
                     break
-                group.append(nxt)
-            self._flush(group)
-            if closing:
-                break
+                npend = admit(nxt)
+            npend = self._maybe_shed(pending, npend)
+            group, npend = self._take_group(pending, npend)
+            set_pending(npend)
+            if group:
+                self._flush(group)
         # drain whatever raced the close sentinel
-        tail = []
         while True:
             try:
                 nxt = self._q.get_nowait()
             except queue.Empty:
                 break
             if nxt is not _CLOSE:
-                tail.append(nxt)
-        for i in range(0, len(tail), self.batch_rows):
-            self._flush(tail[i:i + self.batch_rows])
+                npend = admit(nxt)
+        while npend:
+            group, npend = self._take_group(pending, npend)
+            self._flush(group)
+        set_pending(0)
+
+    @staticmethod
+    def _oldest_t0(pending: dict) -> float:
+        return min(d[0].t0 for d in pending.values() if d)
+
+    def _take_group(self, pending: dict, npend: int):
+        """Pop up to ``batch_rows`` requests, priority classes in
+        ascending order, FIFO within a class."""
+        group = []
+        for prio in sorted(pending):
+            d = pending[prio]
+            while d and len(group) < self.batch_rows:
+                group.append(d.popleft())
+            if len(group) >= self.batch_rows:
+                break
+        for prio in [p for p, d in pending.items() if not d]:
+            del pending[prio]
+        return group, npend - len(group)
+
+    # -- load shedding -------------------------------------------------------
+
+    def _shed_armed(self) -> bool:
+        pol = self.shed
+        if pol.objective is None or pol.engage_frac <= 0:
+            return True
+        with self._lock:
+            p99 = self._p99_last
+        now = time.monotonic()
+        if p99 >= pol.engage_frac * float(pol.objective.bound):
+            # hysteresis: the band stays armed hold_s past its last
+            # firing (flush-loop-owned; see ShedPolicy.hold_s)
+            self._armed_until = now + pol.hold_s
+            return True
+        return now < self._armed_until
+
+    def _maybe_shed(self, pending: dict, npend: int) -> int:
+        """Drop oldest lowest-priority requests while the backlog's
+        projected wait exceeds the deadline (armed by the SLO band).
+        The projection covers the WHOLE backlog — classified pending
+        plus arrivals still in the queue (admission stops pulling at
+        batch_rows, so under overload most of the backlog is there)."""
+        pol = self.shed
+        if pol is None or self._ewma_flush_s <= 0.0:
+            return npend
+        total = npend + self._q.qsize()
+        if total == 0:
+            return npend
+        batches = math.ceil(total / self.batch_rows)
+        if batches * self._ewma_flush_s <= self.deadline_s:
+            return npend
+        if not self._shed_armed():
+            return npend
+        # overload is real and the SLO band is armed: classify the
+        # queued arrivals so their priorities are visible to the drop
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is _CLOSE:
+                self._q.put(_CLOSE)   # re-deliver to the main loop
+                break
+            pending.setdefault(nxt.priority, deque()).append(nxt)
+            npend += 1
+        shed = []
+        while True:
+            batches = math.ceil(npend / self.batch_rows)
+            if batches * self._ewma_flush_s <= self.deadline_s:
+                break
+            low = [p for p, d in pending.items() if p > 0 and d]
+            if not low:
+                break  # nothing sheddable: class 0 always rides it out
+            d = pending[max(low)]
+            shed.append(d.popleft())
+            npend -= 1
+        if not shed:
+            return npend
+        exc = ServeShedError(
+            f"shed by {self.name}: projected queue wait exceeds "
+            f"deadline {self.deadline_s * 1e3:.1f}ms")
+        for req in shed:
+            req._fail(exc)
+        with self._lock:
+            self._shed_total += len(shed)
+        if self._shed_metrics is not None:
+            self._shed_metrics[0].inc(len(shed))
+        self._note_storm(len(shed), npend)
+        return npend
+
+    def _note_storm(self, n: int, depth: int) -> None:
+        now = time.monotonic()
+        times = self._shed_times
+        times.extend([now] * n)
+        cut = now - self.shed.storm_window_s
+        while times and times[0] < cut:
+            times.popleft()
+        if len(times) < self.shed.storm_n:
+            return
+        with self._lock:
+            self._shed_storms += 1
+        if self._shed_metrics is not None:
+            self._shed_metrics[1].inc()
+        times.clear()
+        # one postmortem bundle around the storm; the recorder dedupes
+        # per reason and caps total dumps, so a sustained storm cannot
+        # flood the disk
+        _flight.record(
+            "serve_shed_storm",
+            note=f"{self.name}: {self.shed.storm_n}+ sheds within "
+                 f"{self.shed.storm_window_s:.1f}s; backlog {depth}, "
+                 f"ewma flush {self._ewma_flush_s * 1e3:.2f}ms")
+        log.warning("%s: shed storm (backlog %d)", self.name, depth)
+
+    # -- flush ---------------------------------------------------------------
 
     def _flush(self, group) -> None:
         depth = self._q.qsize()
         full = len(group) >= self.batch_rows
+        t_flush0 = time.monotonic()
         try:
             batch = self._feed.prepare(group)
             with trace.span("serve:forward", cat="serve",
@@ -263,6 +499,10 @@ class ServeFrontend:
                 req._fail(exc)
             return
         now = time.monotonic()
+        flush_s = now - t_flush0
+        self._ewma_flush_s = (flush_s if self._ewma_flush_s == 0.0
+                              else 0.8 * self._ewma_flush_s
+                              + 0.2 * flush_s)
         lats = []
         for i, req in enumerate(group):
             req._resolve(float(margin[i]), float(pred[i]))
@@ -274,19 +514,23 @@ class ServeFrontend:
             self._full_flushes += int(full)
             self._deadline_flushes += int(not full)
             self._depth_max = max(self._depth_max, depth)
+        if now >= self._p99_next:
+            self._p99_next = now + _P99_REFRESH_S
+            with self._lock:
+                # host-sync: _lat holds host floats, no device copy
+                arr = np.asarray(self._lat, np.float64)
+            if arr.size:
+                p99 = float(np.percentile(arr, 99)) * 1e3
+                with self._lock:
+                    self._p99_last = p99
+                if self._metrics is not None:
+                    self._metrics[3].set(p99)
         if self._metrics is not None:
-            req_c, depth_g, lat_h, p99_g = self._metrics
+            req_c, depth_g, lat_h, _ = self._metrics
             req_c.inc(len(group))
             depth_g.max(depth)
             for v in lats:
                 lat_h.observe(v)
-            if now >= self._p99_next:
-                self._p99_next = now + _P99_REFRESH_S
-                with self._lock:
-                    # host-sync: _lat holds host floats, no device copy
-                    arr = np.asarray(self._lat, np.float64)
-                if arr.size:
-                    p99_g.set(float(np.percentile(arr, 99)) * 1e3)
 
     # -- batch assembly (DeviceFeed prep stage) ------------------------------
 

@@ -1,0 +1,128 @@
+"""Shared-nothing request routing across serve replicas.
+
+The reference fans pulls across ~100 ps-lite servers with the caller
+hashing keys to server ranks; the fleet's router is the same idea with
+an explicit spill valve. Two policies:
+
+- ``hash``: consistent hashing over a virtual-node ring (``vnodes``
+  points per replica, blake2b positions). Deterministic: the same
+  request key always lands on the same replica, so any per-replica
+  cache (compiled forward, localizer state, OS page cache) stays warm,
+  and adding/removing a replica remaps only ``1/N`` of the key space.
+- ``spill`` (default): ``hash`` first, then a least-loaded escape —
+  when the hash owner's queue depth exceeds ``spill_frac`` times the
+  fleet mean (and at least ``spill_min`` entries), the request goes to
+  the least-loaded replica instead. The depth signal is the
+  per-replica queue-depth gauges the frontends maintain, read through
+  ``depth_fn`` at route time; a stalled replica therefore stops
+  receiving traffic within one gauge refresh instead of timing out a
+  deadline's worth of requests.
+
+The router itself is stateless apart from the ring (no lock needed:
+routing reads an immutable ring plus a depth snapshot), so N client
+threads can route concurrently.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_right
+from hashlib import blake2b
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Router", "ROUTER_POLICIES", "request_key"]
+
+ROUTER_POLICIES = ("hash", "spill")
+
+
+def _pos(data: bytes) -> int:
+    """Ring position: 64-bit blake2b of ``data`` (stable across runs
+    and processes — NEVER Python ``hash``, which is salted per run and
+    would re-shard the key space on every restart)."""
+    return int.from_bytes(blake2b(data, digest_size=8).digest(), "big")
+
+
+def request_key(keys: Sequence[int]) -> int:
+    """Stable routing key for one request's feature buckets: position
+    of the sorted key bytes. Requests with the same feature set route
+    to the same replica (cache affinity); permutations of the same
+    buckets are the same request, so the sort is part of the key."""
+    arr = np.sort(np.asarray(keys, np.int64).ravel())
+    return _pos(arr.tobytes())
+
+
+class Router:
+    """Consistent-hash ring with optional least-loaded spill."""
+
+    def __init__(self, n_replicas: int, *, policy: str = "spill",
+                 vnodes: int = 128, spill_frac: float = 2.0,
+                 spill_min: int = 8,
+                 depth_fn: Optional[Callable[[int], int]] = None) -> None:
+        if n_replicas < 1:
+            raise ValueError(f"Router needs >= 1 replica, got {n_replicas}")
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(f"unknown router policy {policy!r} "
+                             f"(choose from {ROUTER_POLICIES})")
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.n = int(n_replicas)
+        self.policy = policy
+        self.spill_frac = float(spill_frac)
+        self.spill_min = int(spill_min)
+        self.depth_fn = depth_fn
+        # optional zero-arg callback fired on every spill decision (the
+        # fleet hangs its serve/fleet_spill counter here)
+        self.on_spill: Optional[Callable[[], None]] = None
+        pts = []
+        for r in range(self.n):
+            for v in range(int(vnodes)):
+                pts.append((_pos(f"replica-{r}/vnode-{v}".encode()), r))
+        pts.sort()
+        self._ring_pos = [p for p, _ in pts]
+        self._ring_rep = [r for _, r in pts]
+        self.routed = 0
+        self.spilled = 0
+
+    # -- routing -------------------------------------------------------------
+
+    def owner(self, key: int) -> int:
+        """The consistent-hash owner of ``key`` (no spill)."""
+        i = bisect_right(self._ring_pos, key % (1 << 64))
+        return self._ring_rep[i % len(self._ring_rep)]
+
+    def depths(self) -> List[int]:
+        """Queue-depth snapshot across replicas (0s without a
+        ``depth_fn`` — pure-hash routing needs no signal)."""
+        if self.depth_fn is None:
+            return [0] * self.n
+        return [max(int(self.depth_fn(r)), 0) for r in range(self.n)]
+
+    def route(self, key: int) -> int:
+        """Replica index for routing key ``key`` (see
+        :func:`request_key`). Counts every decision; a spill decision
+        also bumps ``spilled``."""
+        self.routed += 1
+        owner = self.owner(key)
+        if self.policy == "hash" or self.n == 1:
+            return owner
+        depths = self.depths()
+        mean = sum(depths) / self.n
+        d = depths[owner]
+        if d < self.spill_min or d <= self.spill_frac * mean:
+            return owner
+        # least-loaded escape; ties break toward the hash owner so a
+        # uniformly-loaded fleet still keeps cache affinity
+        best = min(range(self.n),
+                   key=lambda r: (depths[r], r != owner))
+        if best != owner:
+            self.spilled += 1
+            if self.on_spill is not None:
+                self.on_spill()
+        return best
+
+    def stats(self) -> dict:
+        return {"policy": self.policy, "replicas": self.n,
+                "routed": self.routed, "spilled": self.spilled,
+                "spill_frac_observed": (self.spilled / self.routed
+                                        if self.routed else 0.0)}

@@ -37,7 +37,23 @@ from wormhole_tpu.utils.logging import get_logger
 
 log = get_logger("serve")
 
-__all__ = ["SnapshotPoller", "ServeRunner"]
+__all__ = ["SnapshotPoller", "ServeRunner", "snapshot_metrics"]
+
+
+def snapshot_metrics(reg):
+    """Single declaration site for the snapshot-retry counter (the
+    lint_knobs unique-name contract)."""
+    return reg.counter("serve/snapshot_retries",
+                       help="snapshot load attempts that failed on a "
+                            "torn/garbage/vanished checkpoint file "
+                            "(each failure doubles the poll backoff)")
+
+
+# ceiling on the failure-backoff multiplier: 2**6 = 64x poll_itv. A
+# checkpoint stuck torn (writer died mid-rename) should not have every
+# replica hammering the store at full cadence forever, but the poller
+# must still notice the eventually-repaired file within ~a minute.
+_MAX_BACKOFF_DOUBLINGS = 6
 
 
 class SnapshotPoller:
@@ -48,10 +64,17 @@ class SnapshotPoller:
     its structure to place leaves. The served params are the subset of
     top-level keys the forward declares (``param_keys()``); extras like
     the step clock are ignored.
+
+    Repeated load failures (same torn file every poll) back off
+    exponentially: the wait after ``k`` consecutive failures is
+    ``poll_itv * 2**k`` capped at ``2**6`` doublings, reset by the next
+    successful load. A healthy store polls at full cadence; a wedged
+    one costs one read per minute instead of one per interval.
     """
 
     def __init__(self, ckpt, template_state: Any, forward, *,
-                 poll_itv: float = 2.0, start_version: int = 0) -> None:
+                 poll_itv: float = 2.0, start_version: int = 0,
+                 registry=None) -> None:
         self.ckpt = ckpt
         self.template = template_state
         self.forward = forward
@@ -61,23 +84,38 @@ class SnapshotPoller:
         # both at once. Readers get monotonic ints, no torn state.
         self.version = int(start_version)  # owner-thread: serve-snapshot
         self.swaps = 0  # owner-thread: serve-snapshot
+        self.retries = 0  # owner-thread: serve-snapshot
+        self._fail_streak = 0  # owner-thread: serve-snapshot
+        self._retry_counter = (None if registry is None
+                               else snapshot_metrics(registry))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def wait_s(self) -> float:
+        """Seconds to sleep before the next poll: the base interval, or
+        the exponential-backoff interval while loads keep failing."""
+        k = min(self._fail_streak, _MAX_BACKOFF_DOUBLINGS)
+        return self.poll_itv * (1 << k)
 
     def poll_once(self) -> bool:  # owner-thread: serve-snapshot
         """Check for a newer version; swap it in if found. Returns True
         on a swap. Races with checkpoint GC (the version can vanish
         between listing and reading) and half-written files surface as
-        OSError/KeyError/ValueError — logged and retried next poll, the
-        front-end keeps serving the current model."""
+        OSError/KeyError/ValueError — logged and retried after backoff,
+        the front-end keeps serving the current model."""
         ver = self.ckpt.latest_version()
         if ver <= self.version:
             return False
         try:
             ver, state = self.ckpt.load(self.template, version=ver)
         except (OSError, KeyError, ValueError) as exc:
-            log.warning("snapshot v%d load failed (%s); retrying "
-                        "next poll", ver, exc)
+            self.retries += 1
+            self._fail_streak += 1
+            if self._retry_counter is not None:
+                self._retry_counter.inc()
+            log.warning("snapshot v%d load failed (%s); retry #%d in "
+                        "%.1fs", ver, exc, self._fail_streak,
+                        self.wait_s())
             return False
         cur = self.forward.params
         fresh = {k: state[k] for k in self.forward.param_keys()}
@@ -91,6 +129,7 @@ class SnapshotPoller:
             self.forward.swap(fresh)
         self.version = ver
         self.swaps += 1
+        self._fail_streak = 0
         log.info("serving model v%d (swap #%d)", ver, self.swaps)
         return True
 
@@ -112,7 +151,7 @@ class SnapshotPoller:
             self._thread = None
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_itv):
+        while not self._stop.wait(self.wait_s()):
             try:
                 self.poll_once()
             except Exception as exc:   # never kill serving over a poll

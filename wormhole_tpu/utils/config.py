@@ -280,6 +280,27 @@ class Config:
     serve_predict: bool = True    # route offline predict() TEST margins
                                   # through the pull-only serve forward
                                   # (eval_step stays the metrics oracle)
+    # --- serve fleet (wormhole_tpu/serve/fleet.py): N replicas behind
+    # the consistent-hash router, freshness via delta snapshot shipping
+    # over the 'serve/snapshot' transport site. See docs/serving.md.
+    serve_fleet_replicas: int = 1   # frontend replica count (1 = solo tier)
+    serve_fleet_router: str = "spill"  # "hash" (pure consistent-hash) or
+                                       # "spill" (+ least-loaded escape)
+    serve_fleet_vnodes: int = 128   # ring virtual nodes per replica
+    serve_fleet_spill_frac: float = 2.0  # spill when owner depth exceeds
+                                         # this multiple of the fleet mean
+    serve_fleet_full_every: int = 16  # every Nth snapshot frame ships full
+                                      # (exact); rest are quantized deltas.
+                                      # 1 = full-only, 0 = fulls on gap only
+    # --- deadline-aware load shedding (frontend priority queue) ---
+    serve_shed_enable: bool = True  # shed sheddable-class work when the
+                                    # projected queue wait exceeds the
+                                    # deadline (class 0 is never shed)
+    serve_shed_engage: float = 0.8  # arm shedding once rolling p99 reaches
+                                    # this fraction of the SLO ceiling
+                                    # (engage before budget burn)
+    serve_shed_storm: int = 64      # sheds within 5s that count as a storm
+                                    # (one FlightRecorder dump each)
     # --- fault tolerance (wormhole_tpu/ft; all off by default) ---
     # collective watchdog: a survivor blocked in a host collective longer
     # than this many seconds exits with the distinguished PEER_LOST code

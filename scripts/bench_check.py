@@ -114,6 +114,7 @@ _RATE_PAT = re.compile(r"(ex_per_sec|examples_per_sec|rows_per_sec)$")
 _LAT_PAT = re.compile(r"(p50_ms|p99_ms)$")
 _SCALE_PAT = re.compile(r"scaling_efficiency$")
 _FUSED_PAT = re.compile(r"fused_over_split$")
+_CACHED_PAT = re.compile(r"cached_over_fused$")
 _DEBT_PAT = re.compile(r"recovery_debt_s$")
 # hierarchy-phase wire keys, gated only under the hierarchy block (the
 # comm_filters / async_ps phases carry same-named leaves with different
@@ -144,6 +145,31 @@ _MIN_SCALING = 0.05
 # — 0.95 keeps single-core timing noise from flapping a 2.8% margin
 # while catching a real fused-path slowdown; gate TPU runs at 1.0.
 _MIN_FUSED_RATIO = 0.95
+# absolute floor on the newest BENCH run's *cached_over_fused ratio
+# (tile_fused phase, narrow-block cache-on vs cache-off A/B in the
+# same interleaved windows). On the TPU backend the phase-shared
+# one-hot cache exists to beat the per-phase rebuild it replaces, so
+# < 1.0 there is a regression — gate TPU runs at 1.0. The CPU default
+# is calibrated to the Pallas interpreter, where the staged planes are
+# pure extra numpy work (no VMEM refetch to save): the narrow bench
+# geometry measures ~0.08, so 0.05 passes the honest CPU number with
+# headroom while still catching a cache path that wedges outright.
+_MIN_CACHED_RATIO = 0.05
+# the tile_fused phase's resolution records, gated as string PREFIXES
+# on the newest run: round 8 widened the fused admissibility, so a
+# spill view of the bench file and a wide&deep store must both resolve
+# fused, and the cached A/B must run at a geometry whose cache the
+# resolver's auto budget genuinely admits (a forced-past-budget cache
+# would not compile on the TPU backend, so timing one proves nothing).
+# Prefixes, not exact strings: the linear store refines its record to
+# "fused_update" when the in-place FTRL variant dispatches — any
+# fused-family resolution passes, any split fails.
+_TILE_RESOLUTION_EXPECT = {
+    "resolved_kernel": "fused",
+    "spill_resolved_kernel": "fused",
+    "wd_resolved_kernel": "fused",
+    "cache_record": "onehot_cache=on",
+}
 # absolute ceiling on the newest BENCH run's *recovery_debt_s (bench.py
 # --phases rejoin: heartbeat detection -> rejoiner admitted, dominated
 # on CPU by the rejoiner's checkpoint restore + first-window jit
@@ -403,6 +429,53 @@ def fused_floor(name: str, parsed: dict, min_ratio: float) -> List[str]:
         if v < min_ratio]
 
 
+def cached_ratio_keys(parsed: dict) -> Dict[str, float]:
+    """``*cached_over_fused`` ratio keys (tile_fused phase)."""
+    return _keys_matching(parsed, _CACHED_PAT)
+
+
+def cached_floor(name: str, parsed: dict, min_ratio: float) -> List[str]:
+    """Absolute floor on the newest run's cached/fused step ratio: the
+    one-hot cache replay must not fall below its backend's calibrated
+    floor vs the rebuild it skips (same-window interleaved, so the
+    ratio holds even on a contended chip)."""
+    return [
+        f"{key}: {v:.3f} < --min-cached-ratio {min_ratio:.3f} ({name}) "
+        "— one-hot cache replay below the floor vs the per-phase "
+        "rebuild"
+        for key, v in sorted(cached_ratio_keys(parsed).items())
+        if v < min_ratio]
+
+
+def tile_resolution_gate(name: str, parsed: dict) -> List[str]:
+    """Absolute gate on the newest run's tile_fused resolution records:
+    every :data:`_TILE_RESOLUTION_EXPECT` key found under a
+    ``tile_fused`` block must carry its expected string — a spill view
+    or wide&deep store resolving split means the round-8 admissibility
+    widening regressed, and a cache record other than ``on`` means the
+    cached A/B timed an inadmissible (or disabled) cache. Keys absent
+    from the run (pre-round-8 snapshots) are skipped — the records are
+    gated, not required retroactively."""
+    bad: List[str] = []
+
+    def walk(node, path: str) -> None:
+        if not isinstance(node, dict):
+            return
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else k
+            if isinstance(v, dict):
+                walk(v, p)
+            elif isinstance(v, str) and k in _TILE_RESOLUTION_EXPECT \
+                    and ".tile_fused" in f".{p}":
+                want = _TILE_RESOLUTION_EXPECT[k]
+                if not v.startswith(want):
+                    bad.append(
+                        f"{p}: {v!r} != {want!r} ({name}) — tile_fused "
+                        "resolution record regressed")
+    walk(parsed, "")
+    return bad
+
+
 def debt_keys(parsed: dict) -> Dict[str, float]:
     """``*recovery_debt_s`` keys (rejoin phase)."""
     return _keys_matching(parsed, _DEBT_PAT)
@@ -605,6 +678,7 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      tol_frac: float, all_pairs: bool,
                      min_scaling: float, min_fused_ratio: float,
                      max_recovery_debt: float, slo: bool = False,
+                     min_cached_ratio: float = _MIN_CACHED_RATIO,
                      max_drift: float = _MAX_DRIFT,
                      max_burn: float = _MAX_BURN,
                      min_wire_ratio: float = _MIN_WIRE_RATIO,
@@ -620,6 +694,8 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
         failures.extend(scaling_floor(*runs[-1], min_scaling))
     if prefix == "BENCH" and runs:
         failures.extend(fused_floor(*runs[-1], min_fused_ratio))
+        failures.extend(cached_floor(*runs[-1], min_cached_ratio))
+        failures.extend(tile_resolution_gate(*runs[-1]))
         failures.extend(debt_ceiling(*runs[-1], max_recovery_debt))
         failures.extend(hier_wire_gate(*runs[-1], min_wire_ratio))
         failures.extend(bigmodel_gate(*runs[-1], min_bigmodel_ratio))
@@ -651,7 +727,9 @@ def run(bench_dir: str, tol: float, tol_frac: float,
         all_pairs: bool = False, min_scaling: float = _MIN_SCALING,
         min_fused_ratio: float = _MIN_FUSED_RATIO,
         max_recovery_debt: float = _MAX_RECOVERY_DEBT,
-        slo: bool = False, max_drift: float = _MAX_DRIFT,
+        slo: bool = False,
+        min_cached_ratio: float = _MIN_CACHED_RATIO,
+        max_drift: float = _MAX_DRIFT,
         max_burn: float = _MAX_BURN,
         min_wire_ratio: float = _MIN_WIRE_RATIO,
         min_bigmodel_ratio: float = _MIN_BIGMODEL_RATIO,
@@ -663,7 +741,9 @@ def run(bench_dir: str, tol: float, tol_frac: float,
         f, p, c = _gate_trajectory(prefix, bench_dir, tol, tol_frac,
                                    all_pairs, min_scaling,
                                    min_fused_ratio, max_recovery_debt,
-                                   slo=slo, max_drift=max_drift,
+                                   slo=slo,
+                                   min_cached_ratio=min_cached_ratio,
+                                   max_drift=max_drift,
                                    max_burn=max_burn,
                                    min_wire_ratio=min_wire_ratio,
                                    min_bigmodel_ratio=min_bigmodel_ratio,
@@ -709,6 +789,15 @@ def main(argv=None) -> int:
                          "interpret-mode fused step measures 1.028 vs "
                          "split; gate TPU runs at 1.0 — the fused step "
                          "must not be slower than the split oracle)")
+    ap.add_argument("--min-cached-ratio", type=float,
+                    default=_MIN_CACHED_RATIO,
+                    help="absolute floor on the newest BENCH run's "
+                         "*cached_over_fused ratio (default "
+                         f"{_MIN_CACHED_RATIO}, CPU-calibrated: the "
+                         "interpret-mode cache replay measures ~0.08 "
+                         "because the staged planes are pure extra "
+                         "work there; gate TPU runs at 1.0 — the "
+                         "cache must beat the rebuild it skips)")
     ap.add_argument("--max-recovery-debt", type=float,
                     default=_MAX_RECOVERY_DEBT,
                     help="absolute ceiling (seconds) on the newest "
@@ -763,7 +852,8 @@ def main(argv=None) -> int:
                all_pairs=args.all_pairs, min_scaling=args.min_scaling,
                min_fused_ratio=args.min_fused_ratio,
                max_recovery_debt=args.max_recovery_debt,
-               slo=args.slo, max_drift=args.max_drift,
+               slo=args.slo, min_cached_ratio=args.min_cached_ratio,
+               max_drift=args.max_drift,
                max_burn=args.max_burn,
                min_wire_ratio=args.min_wire_ratio,
                min_bigmodel_ratio=args.min_bigmodel_ratio,

@@ -3,14 +3,21 @@ on real TPU hardware, checks them against the exact numpy oracle, and
 sweeps tiles_step. Not part of the bench; a dev tool.
 
 Usage: python scripts/ktune.py [reps] [tb1,tb2,...]
-       python scripts/ktune.py --kernel fused|split|both \
+       python scripts/ktune.py --kernel fused|split|both|cached|both3 \
            [--windows N] [--burn N] [reps]
 
 ``--kernel`` times the full FTRL train step instead of the bare
 fwd/bwd pair; ``both`` is the A/B mode — each window times split and
 fused back-to-back, so the per-window ratio is contention-robust on
 the shared chip (the round-4/5 interleaved methodology) even when the
-absolute times are not.
+absolute times are not. ``cached`` drives the fused step with the
+phase-shared one-hot cache forced on; ``both3`` is the round-8
+three-way interleave: each window runs split, fused, and fused+cache
+back-to-back and reports both per-window ratios. The cached modes
+drop to a narrow-block geometry (one subblock, nnz=16, same bucket
+space) where the resolver's auto genuinely admits the cache — at the
+default wide geometry the planes need ~2.1 GB of VMEM and the kernel
+would not compile on a TPU, so there is nothing to measure there.
 """
 from __future__ import annotations
 
@@ -69,7 +76,7 @@ def _build_ab_steps(spec, which):
     handle = FTRLHandle(penalty=L1L2(1.0, 0.1), lr=LearnRate(0.1, 1.0))
     _, dual_fn = create_loss("logit")
     steps = {}
-    if which in ("split", "both"):
+    if which in ("split", "both", "both3"):
         @jax.jit
         def split_step(pw, s32, labels, mask):
             w = handle.weights(s32)
@@ -79,12 +86,21 @@ def _build_ab_steps(spec, which):
             new = handle.push(s32, grad, jnp.float32(0), jnp.float32(0))
             return margin, new
         steps["split"] = split_step
-    if which in ("fused", "both"):
+    if which in ("fused", "both", "both3"):
         @jax.jit
         def fused_step(pw, s32, labels, mask):
             return tilemm.fused_step_update(pw, s32, labels, mask,
                                             spec, "logit", handle)
         steps["fused"] = fused_step
+    if which in ("cached", "both3"):
+        # cache forced past the resolver's VMEM budget model — this is
+        # the measurement mode the `on` knob exists for
+        @jax.jit
+        def cached_step(pw, s32, labels, mask):
+            return tilemm.fused_step_update(pw, s32, labels, mask,
+                                            spec, "logit", handle,
+                                            cache=True)
+        steps["cached"] = cached_step
     return handle, steps
 
 
@@ -105,7 +121,7 @@ def _kernel_ab(spec, pw, which, reps, windows=10, burn=20):
             o = fn(pw, s32, labels, mask)
         _force(o)
     best = {name: float("inf") for name in steps}
-    ratios = []
+    ratios = {"split/fused": [], "fused/cached": []}
     for _ in range(windows):
         win = {}
         for name, fn in steps.items():
@@ -116,15 +132,18 @@ def _kernel_ab(spec, pw, which, reps, windows=10, burn=20):
             _force(o)
             win[name] = (time.perf_counter() - t0) / reps
             best[name] = min(best[name], win[name])
-        if len(win) == 2:
-            ratios.append(win["split"] / win["fused"])
+        if "split" in win and "fused" in win:
+            ratios["split/fused"].append(win["split"] / win["fused"])
+        if "fused" in win and "cached" in win:
+            ratios["fused/cached"].append(win["fused"] / win["cached"])
     for name, t in best.items():
-        print(f"{name:5s} step {t*1e3:7.3f} ms -> "
+        print(f"{name:6s} step {t*1e3:7.3f} ms -> "
               f"{spec.block_rows/t/1e6:.2f} M ex/s")
-    if ratios:
-        print(f"split/fused ratio: median {np.median(ratios):.3f} "
-              f"min {min(ratios):.3f} max {max(ratios):.3f} "
-              f"({len(ratios)} interleaved windows)")
+    for label, rs in ratios.items():
+        if rs:
+            print(f"{label} ratio: median {np.median(rs):.3f} "
+                  f"min {min(rs):.3f} max {max(rs):.3f} "
+                  f"({len(rs)} interleaved windows)")
 
 
 def main():
@@ -133,9 +152,9 @@ def main():
     if "--kernel" in args:
         i = args.index("--kernel")
         kernel = args[i + 1]
-        if kernel not in ("fused", "split", "both"):
-            raise SystemExit(f"--kernel must be fused|split|both, "
-                             f"got {kernel!r}")
+        if kernel not in ("fused", "split", "both", "cached", "both3"):
+            raise SystemExit(f"--kernel must be fused|split|both|"
+                             f"cached|both3, got {kernel!r}")
         del args[i:i + 2]
     # single-core hosts drive the fused kernel through interpret mode
     # at ~10s/step — the TPU defaults (10 windows, 20-step burn) would
@@ -153,16 +172,21 @@ def main():
     tbs = ([int(x) for x in args[1].split(",")]
            if len(args) > 1 else [])
     from wormhole_tpu.data.crec import default_cap
-    spec = tilemm.make_spec(NB, ROWS // tilemm.RSUB, default_cap(NNZ, NB))
+    rows_n, nnz = ROWS, NNZ
+    if kernel in ("cached", "both3"):
+        # cache-admissible narrow geometry (see module docstring)
+        rows_n, nnz = tilemm.RSUB, 16
+    spec = tilemm.make_spec(NB, rows_n // tilemm.RSUB,
+                            default_cap(nnz, NB))
     print("spec:", spec)
 
     rng = np.random.default_rng(0)
-    buckets = rng.integers(0, NB, size=ROWS * NNZ, dtype=np.int64)
-    rows = np.repeat(np.arange(ROWS, dtype=np.int64), NNZ)
+    buckets = rng.integers(0, NB, size=rows_n * nnz, dtype=np.int64)
+    rows = np.repeat(np.arange(rows_n, dtype=np.int64), nnz)
     pw_np, ovb, _ = tilemm.encode_block(buckets, rows, spec)
     print(f"overflow pairs: {len(ovb)}")
     w_np = rng.normal(0, 0.1, NB).astype(np.float32)
-    dual_np = rng.normal(0, 1.0, ROWS).astype(np.float32)
+    dual_np = rng.normal(0, 1.0, rows_n).astype(np.float32)
     # device-resident operands: numpy args would re-upload ~90 MB per
     # call through the host transport and swamp the kernel timing
     pw, w, dual = (jax.device_put(x) for x in (pw_np, w_np, dual_np))

@@ -613,3 +613,70 @@ def test_fleet_latency_keys_excluded_from_trend(tmp_path):
     _write_run(d, 2, _parsed(100_000.0, _fleet(p99_x2=20.0)))
     r = _run("--dir", d)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _tile(fused=1.03, cached=0.08, cache_rec="onehot_cache=on",
+          spill="fused", wd="fused"):
+    return {"tile_fused_vs_split": {
+        "tile_fused_ex_per_sec": 9_600.0,
+        "tile_split_ex_per_sec": 9_100.0,
+        "tile_cached_ex_per_sec": 700.0,
+        "tile_narrow_fused_ex_per_sec": 8_700.0,
+        "fused_over_split": fused,
+        "cached_over_fused": cached,
+        "resolved_kernel": "fused",
+        "cache_record": cache_rec,
+        "spill_resolved_kernel": spill,
+        "wd_resolved_kernel": wd}}
+
+
+def test_fused_ratio_floor_gates_newest_run(tmp_path):
+    # a single usable run is enough for the absolute floor
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _tile(fused=0.7)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-fused-ratio" in r.stderr
+    # the flag relaxes the floor, same machinery as the other absolutes
+    r2 = _run("--dir", d, "--min-fused-ratio", "0.5")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_cached_ratio_floor_gates_newest_run(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _tile(cached=0.01)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-cached-ratio" in r.stderr
+    assert "one-hot cache replay below the floor" in r.stderr
+    # the flag relaxes the floor; the CPU-calibrated default (0.05)
+    # passes the honest interpret-mode measurement (~0.08)
+    r2 = _run("--dir", d, "--min-cached-ratio", "0.005")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    _write_run(d, 1, _parsed(100_000.0, _tile()))
+    assert _run("--dir", d).returncode == 0
+
+
+def test_tile_resolution_records_gated(tmp_path):
+    """Round-8 admissibility acceptance: the spill view and the
+    wide&deep store must record a fused resolution, and the cached A/B
+    must run at a geometry whose cache auto genuinely admits; a
+    pre-round-8 snapshot without the records is skipped, not failed."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _tile(spill="split")))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "spill_resolved_kernel" in r.stderr
+    assert "resolution record regressed" in r.stderr
+    _write_run(d, 1, _parsed(100_000.0, _tile(wd="split")))
+    assert "wd_resolved_kernel" in _run("--dir", d).stderr
+    _write_run(d, 1, _parsed(
+        100_000.0, _tile(cache_rec="onehot_cache=off:forced off")))
+    assert "cache_record" in _run("--dir", d).stderr
+    # records absent entirely (old snapshot): skipped, not required
+    blk = _tile()
+    for k in ("resolved_kernel", "cache_record",
+              "spill_resolved_kernel", "wd_resolved_kernel"):
+        del blk["tile_fused_vs_split"][k]
+    _write_run(d, 1, _parsed(100_000.0, blk))
+    assert _run("--dir", d).returncode == 0

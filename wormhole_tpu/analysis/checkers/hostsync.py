@@ -62,6 +62,19 @@ HOT_PATHS = {
         "PagedStore.flush",
         "PagedStore.stage_fresh",
     ),
+    # the tile dispatch branches: one pallas dispatch per block under a
+    # device_compute span (tilemm:fused_step / fused_cached /
+    # fused_multi / mlp_phase) — an unmarked sync here serializes the
+    # kernel stream the spans are supposed to measure
+    "wormhole_tpu/learners/store.py": (
+        "ShardedStore.tile_train_step",
+    ),
+    "wormhole_tpu/models/fm.py": (
+        "FMStore.tile_train_step",
+    ),
+    "wormhole_tpu/models/wide_deep.py": (
+        "WideDeepStore.tile_train_step",
+    ),
 }
 
 _NP_NAMES = {"np", "numpy", "onp"}

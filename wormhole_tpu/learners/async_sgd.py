@@ -85,7 +85,8 @@ class AsyncSGD:
                             fixed_bytes=cfg.fixed_bytes,
                             lr_theta=cfg.lr_theta,
                             param_dtype=cfg.param_dtype,
-                            tile_step_kernel=cfg.tile_step_kernel),
+                            tile_step_kernel=cfg.tile_step_kernel,
+                            tile_onehot_cache=cfg.tile_onehot_cache),
                 handle, self.rt)
         elif (buckets := getattr(getattr(store, "cfg", None),
                                  "num_buckets", None)) is not None \
@@ -103,6 +104,8 @@ class AsyncSGD:
         check_choice("tile_online", cfg.tile_online, ("auto", "on", "off"))
         check_choice("tile_step_kernel", cfg.tile_step_kernel,
                      ("auto", "fused", "split"))
+        check_choice("tile_onehot_cache", cfg.tile_onehot_cache,
+                     ("auto", "on", "off"))
         self.localizer = Localizer(num_buckets=cfg.num_buckets,
                                    tail_freq=cfg.tail_feature_freq)
         self.pool = WorkloadPool()

@@ -272,6 +272,10 @@ class StoreConfig:
     tile_step_kernel: str = "auto"  # auto|fused|split: one-grid fused
                                     # train step vs the two-call split
                                     # oracle (ops/tilemm.py)
+    tile_onehot_cache: str = "auto"  # auto|on|off: phase-shared one-hot
+                                     # plane cache inside the fused grid
+                                     # (auto = VMEM budget model decides;
+                                     # ops/tilemm.resolve_step_kernel)
 
 
 class TableCheckpoint:
@@ -706,14 +710,19 @@ class ShardedStore(TableCheckpoint):
         oc = info.ovf_cap
         loss_name = self.cfg.loss
         # The fused one-grid step replaces the fwd/bwd pallas pair when
-        # the geometry admits it (no spill blocks); the in-place slot
-        # update additionally needs an FTRL handle and a single process
-        # (multihost gradients cross the wire before the update, so they
-        # must exist in HBM — the grad-emitting fused variant covers it).
-        mode, why = tilemm.resolve_step_kernel(
-            getattr(self.cfg, "tile_step_kernel", "auto"), ovf_cap=oc)
-        fused = mode == "fused" and kind == "train"
-        fused_update = (fused and isinstance(handle, FTRLHandle)
+        # the geometry admits it; the in-place slot update additionally
+        # needs an FTRL handle, no spill (the COO scatter needs the grad
+        # in HBM) and a single process (multihost gradients cross the
+        # wire before the update — the grad-emitting fused variant
+        # covers both).
+        res = tilemm.resolve_step_kernel(
+            getattr(self.cfg, "tile_step_kernel", "auto"), ovf_cap=oc,
+            spec=spec,
+            onehot_cache=getattr(self.cfg, "tile_onehot_cache", "auto"))
+        fused = res.kernel == "fused" and kind == "train"
+        cache = fused and res.cache
+        fused_update = (fused and oc == 0
+                        and isinstance(handle, FTRLHandle)
                         and jax.process_count() == 1)
 
         def decode(block):
@@ -750,7 +759,33 @@ class ShardedStore(TableCheckpoint):
                 pw, labels, row_mask, _ovf_b, _ovf_r = decode(block)
                 s32 = slots.astype(jnp.float32)
                 margin, new = tilemm.fused_step_update(
-                    pw, s32, labels, row_mask, spec, loss_name, handle)
+                    pw, s32, labels, row_mask, spec, loss_name, handle,
+                    cache=cache)
+                return finish(slots, s32, new, margin, labels, row_mask,
+                              t, macc)
+        elif fused and oc:
+            # fused spill branch: the pre-aggregated spill margins ride
+            # into the kernel as one extra operand (summed into the
+            # phase-boundary dual); the spill pairs' grad contributions
+            # scatter in XLA from the emitted margins — the dual
+            # recompute is elementwise, so the scattered duals are
+            # bitwise the kernel's own
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                pw, labels, row_mask, ovf_b, ovf_r = decode(block)
+                s32 = slots.astype(jnp.float32)
+                w = handle.weights(s32)
+                sp = tilemm.spill_margin_rows(w, ovf_b, ovf_r, spec)
+                margin, grad = tilemm.fused_step_grad(
+                    pw, w, labels, row_mask, spec, loss_name, exact_dense,
+                    cache=cache, spill_margins=sp)
+                dual = dual_fn(margin, labels, row_mask)
+                if not exact_dense:
+                    dual = _nudge_zero_dual(dual, labels, row_mask)
+                grad = tilemm.spill_grad_scatter(grad, dual, ovf_b,
+                                                 ovf_r, spec)
+                new = masked_push(handle, s32, grad,
+                                  t.astype(jnp.float32), tau, exact_dense)
                 return finish(slots, s32, new, margin, labels, row_mask,
                               t, macc)
         elif fused:
@@ -760,7 +795,8 @@ class ShardedStore(TableCheckpoint):
                 s32 = slots.astype(jnp.float32)
                 w = handle.weights(s32)
                 margin, grad = tilemm.fused_step_grad(
-                    pw, w, labels, row_mask, spec, loss_name, exact_dense)
+                    pw, w, labels, row_mask, spec, loss_name, exact_dense,
+                    cache=cache)
                 new = masked_push(handle, s32, grad,
                                   t.astype(jnp.float32), tau, exact_dense)
                 return finish(slots, s32, new, margin, labels, row_mask,
@@ -807,13 +843,16 @@ class ShardedStore(TableCheckpoint):
             self._tile_kernel = {}
         if kind != "train":
             resolved, why = "split", "eval is forward-only"
-        elif fused_update:
-            resolved = "fused_update"
-        elif fused:
-            resolved = "fused"
+            cache_rec = "onehot_cache=off:eval is forward-only"
         else:
-            resolved = "split"
-        self._tile_kernel[key] = (resolved, why)
+            why, cache_rec = res.why, res.cache_record
+            if fused_update:
+                resolved = "fused_update"
+            elif fused:
+                resolved = "fused"
+            else:
+                resolved = "split"
+        self._tile_kernel[key] = (resolved, why, cache_rec)
         self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
@@ -956,10 +995,16 @@ class ShardedStore(TableCheckpoint):
         step = self._tile_step(info, "train")
         if self.step_kernel[0].startswith("fused"):
             from wormhole_tpu.obs import trace
-            with trace.span("tilemm:fused_step", cat="tile"):
-                self.slots, t_new, self._macc, ticket = step(
-                    self.slots, block, self._t_device(),
-                    self._tau_const(tau), self._macc_buf())
+            if self.step_kernel[2] == "onehot_cache=on":
+                with trace.span("tilemm:fused_cached", cat="tile"):
+                    self.slots, t_new, self._macc, ticket = step(
+                        self.slots, block, self._t_device(),
+                        self._tau_const(tau), self._macc_buf())
+            else:
+                with trace.span("tilemm:fused_step", cat="tile"):
+                    self.slots, t_new, self._macc, ticket = step(
+                        self.slots, block, self._t_device(),
+                        self._tau_const(tau), self._macc_buf())
         else:
             self.slots, t_new, self._macc, ticket = step(
                 self.slots, block, self._t_device(), self._tau_const(tau),

@@ -55,6 +55,11 @@ class FMConfig:
     tile_step_kernel: str = "auto"  # auto|fused|split: one-grid fused
                                     # tile train step vs the two-call
                                     # split oracle (ops/tilemm.py)
+    tile_onehot_cache: str = "auto"  # auto|on|off — accepted for config
+                                     # parity; the multi-channel FM
+                                     # kernel shares one one-hot build
+                                     # already, so this always resolves
+                                     # off (tilemm.resolve_step_kernel)
 
 
 def fm_margin(theta: jax.Array, batch: SparseBatch) -> jax.Array:
@@ -196,9 +201,11 @@ class FMStore(TableCheckpoint):
         penalty = L1L2(cfg.l1, cfg.l2)
         spec = info.spec
         oc = info.ovf_cap
-        mode, why = tilemm.resolve_step_kernel(
-            getattr(cfg, "tile_step_kernel", "auto"), ovf_cap=oc)
-        fused = mode == "fused" and kind == "train"
+        res = tilemm.resolve_step_kernel(
+            getattr(cfg, "tile_step_kernel", "auto"), ovf_cap=oc,
+            spec=spec, channels=k + 2,
+            onehot_cache=getattr(cfg, "tile_onehot_cache", "auto"))
+        fused = res.kernel == "fused" and kind == "train"
 
         def decode(block):
             lab_u8 = block["labels"]
@@ -260,7 +267,25 @@ class FMStore(TableCheckpoint):
             return (new.astype(slots.dtype), t + 1, macc + packed,
                     num_ex)
 
-        if fused:
+        if fused and oc:
+            # fused spill branch: pre-aggregated spill pulls ride into
+            # the kernel as an extra grid operand (summed into the
+            # boundary pulls); the kernel emits the (rows, ch) dual
+            # channels so the spill pairs' pushes scatter in XLA
+            @partial(jax.jit, donate_argnums=(0, 2, 4))
+            def step(slots, block, t, tau, macc):
+                s32 = slots.astype(jnp.float32)
+                pw, labels, row_mask, ovf_b, ovf_r = decode(block)
+                wpull = make_wpull(s32)
+                sp = tilemm.spill_pull_rows(wpull, ovf_b, ovf_r, spec)
+                margin, push, dv = tilemm.fused_fm_step(
+                    pw, wpull, labels, row_mask, spec, k, cfg.loss,
+                    spill_pulls=sp)
+                push = tilemm.spill_push_scatter(push, dv, ovf_b,
+                                                 ovf_r, spec)
+                return update(s32, push, margin, labels, row_mask,
+                              slots, t, macc)
+        elif fused:
             @partial(jax.jit, donate_argnums=(0, 2, 4))
             def step(slots, block, t, tau, macc):
                 s32 = slots.astype(jnp.float32)
@@ -302,9 +327,12 @@ class FMStore(TableCheckpoint):
         if not hasattr(self, "_tile_kernel"):
             self._tile_kernel = {}
         if kind != "train":
-            self._tile_kernel[key] = ("split", "eval is forward-only")
+            self._tile_kernel[key] = (
+                "split", "eval is forward-only",
+                "onehot_cache=off:eval is forward-only")
         else:
-            self._tile_kernel[key] = ("fused" if fused else "split", why)
+            self._tile_kernel[key] = ("fused" if fused else "split",
+                                      res.why, res.cache_record)
         self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
@@ -534,14 +562,16 @@ def main(argv=None) -> int:
 
     args = list(sys.argv[1:] if argv is None else argv)
     conf = args.pop(0) if args and "=" not in args[0] else None
-    shared = {"num_buckets", "loss", "seed", "tile_step_kernel"}
+    shared = {"num_buckets", "loss", "seed", "tile_step_kernel",
+              "tile_onehot_cache"}
     model_keys = {f.name for f in _dc.fields(FMConfig)} - shared
     model_kvs = [a for a in args
                  if a.partition("=")[0].strip() in model_keys]
     cfg = load_config(conf, [a for a in args if a not in model_kvs])
     mcfg = FMConfig(num_buckets=cfg.num_buckets, loss=cfg.loss.value,
                     seed=cfg.seed,
-                    tile_step_kernel=cfg.tile_step_kernel)
+                    tile_step_kernel=cfg.tile_step_kernel,
+                    tile_onehot_cache=cfg.tile_onehot_cache)
     apply_kvs(mcfg, model_kvs)
     rt = MeshRuntime.create(cfg.mesh_shape)
     AsyncSGD(cfg, rt, store=FMStore(mcfg, rt)).run()

@@ -52,9 +52,14 @@ class WideDeepConfig:
     l2_v: float = 1e-5
     init_scale: float = 0.01
     seed: int = 0
-    tile_step_kernel: str = "auto"  # accepted for config parity; the
-                                    # deep MLP vjp always resolves split
+    tile_step_kernel: str = "auto"  # auto|fused|split: the MLP vjp runs
+                                    # in-kernel at the fused phase
+                                    # boundary when the dense
+                                    # activations fit the VMEM budget
                                     # (ops/tilemm.resolve_step_kernel)
+    tile_onehot_cache: str = "auto"  # auto|on|off — accepted for config
+                                     # parity; the multi-channel wd
+                                     # kernel always resolves off
 
 
 def init_mlp(sizes: List[int], rng: np.random.Generator):
@@ -70,13 +75,10 @@ def init_mlp(sizes: List[int], rng: np.random.Generator):
             jax.tree.map(jnp.asarray, accum))
 
 
-def mlp_forward(params: dict, x: jax.Array, n_layers: int) -> jax.Array:
-    h = x
-    for i in range(n_layers):
-        h = h @ params[f"W{i}"] + params[f"b{i}"]
-        if i < n_layers - 1:
-            h = jax.nn.relu(h)
-    return h[:, 0]
+# The deep-tower forward lives in ops/tilemm.py so the fused wd step can
+# run the SAME function (and the same jax.vjp of it) inside the kernel's
+# boundary phase — re-exported here for the split path and external users.
+from wormhole_tpu.ops.tilemm import mlp_forward  # noqa: E402,F401
 
 
 class WideDeepStore(TableCheckpoint):
@@ -213,12 +215,15 @@ class WideDeepStore(TableCheckpoint):
         from wormhole_tpu.ops.metrics import margin_hist
         cfg = self.cfg
         k = cfg.dim
-        # validates the knob and records WHY this store never fuses:
-        # the MLP vjp runs between the embedding pulls and the pushes
-        mode, why = tilemm.resolve_step_kernel(
+        # the MLP vjp runs in-kernel at the fused phase boundary when
+        # the dense activations fit the VMEM budget; spill blocks and
+        # oversized hidden widths fall back split with a recorded reason
+        res = tilemm.resolve_step_kernel(
             getattr(cfg, "tile_step_kernel", "auto"), ovf_cap=info.ovf_cap,
-            deep=True)
-        assert mode == "split"
+            deep=True, spec=info.spec, dim=k, hidden=tuple(cfg.hidden),
+            channels=k + 2,
+            onehot_cache=getattr(cfg, "tile_onehot_cache", "auto"))
+        fused = res.kernel == "fused" and kind == "train"
         n_layers = self.n_layers
         objv_fn = self.objv_fn
         _, dual_fn = create_loss(cfg.loss)
@@ -245,46 +250,70 @@ class WideDeepStore(TableCheckpoint):
             return (pw, labels, row_mask, ovf_b, ovf_r, pooled, vjp,
                     margin)
 
-        if kind == "train":
+        def finish(slots, s32, mlp, accum, push, g_mlp, margin, labels,
+                   row_mask, t, macc):
+            # shared update/metric tail downstream of the push buffer
+            # and MLP grads — structurally identical XLA in the fused
+            # and split programs, so the update bits agree between them
+            theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
+            v = theta[:, 1:]
+            objv = objv_fn(margin, labels, row_mask)
+            touched = push[:, 1 + k] > 0
+            g_v = push[:, 1:1 + k] + cfg.l2_v * v * touched[:, None]
+            grads = jnp.concatenate([push[:, :1], g_v], axis=1)
+            cg_new = jnp.where(touched[:, None],
+                               jnp.sqrt(cg * cg + grads * grads), cg)
+            eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
+            theta_new = jnp.where(touched[:, None],
+                                  theta - eta * grads, theta)
+            new = jnp.concatenate([theta_new, cg_new], axis=1)
+            accum = jax.tree.map(
+                lambda a, g: jnp.sqrt(a * a + g * g), accum, g_mlp)
+            mlp_new = jax.tree.map(
+                lambda p, g, a: p - cfg.lr_alpha_dense
+                / (cfg.lr_beta + a) * g, mlp, g_mlp, accum)
+            num_ex = jnp.sum(row_mask)
+            acc = accuracy(labels, margin, row_mask)
+            pos, neg = margin_hist(labels, margin, row_mask)
+            d0 = theta_new[:, 0] - theta[:, 0]
+            packed = jnp.concatenate([
+                jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
+                pos, neg])
+            # num_ex = completion ticket; the clock/macc outputs are
+            # donated into the next step (see ShardedStore._tile_step)
+            return (new.astype(slots.dtype), mlp_new, accum, t + 1,
+                    macc + packed, num_ex)
+
+        if fused:
+            # one grid: embedding pulls, in-kernel MLP forward/vjp at
+            # the phase boundary, dual, channel pushes and MLP param
+            # grads in a single dispatch (resolve_step_kernel admits
+            # this only for spill-free blocks within the VMEM budget)
             @partial(jax.jit, donate_argnums=(0, 1, 2, 4, 6))
             def step(slots, mlp, accum, block, t, tau, macc):
                 s32 = slots.astype(jnp.float32)
-                theta, cg = s32[:, :1 + k], s32[:, 1 + k:]
-                v = theta[:, 1:]
+                pw, labels, row_mask, _ovf_b, _ovf_r = decode(block)
+                w, v = s32[:, 0], s32[:, 1:1 + k]
+                wpull = jnp.concatenate([w[:, None], v], axis=1)
+                margin, push, g_mlp = tilemm.fused_wd_step(
+                    pw, wpull, labels, row_mask, mlp, spec, k,
+                    tuple(cfg.hidden), cfg.loss)
+                return finish(slots, s32, mlp, accum, push, g_mlp,
+                              margin, labels, row_mask, t, macc)
+        elif kind == "train":
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 4, 6))
+            def step(slots, mlp, accum, block, t, tau, macc):
+                s32 = slots.astype(jnp.float32)
                 (pw, labels, row_mask, ovf_b, ovf_r, pooled, vjp,
                  margin) = forward(s32, mlp, block)
-                objv = objv_fn(margin, labels, row_mask)
                 dual = dual_fn(margin, labels, row_mask)
                 g_mlp, g_pooled = vjp(dual)
                 dvals = jnp.concatenate(
                     [dual[:, None], g_pooled, row_mask[:, None]], axis=1)
                 push = tilemm.backward_pushes(pw, dvals, spec,
                                               ovf_b, ovf_r)
-                touched = push[:, 1 + k] > 0
-                g_v = push[:, 1:1 + k] + cfg.l2_v * v * touched[:, None]
-                grads = jnp.concatenate([push[:, :1], g_v], axis=1)
-                cg_new = jnp.where(touched[:, None],
-                                   jnp.sqrt(cg * cg + grads * grads), cg)
-                eta = cfg.lr_alpha / (cfg.lr_beta + cg_new)
-                theta_new = jnp.where(touched[:, None],
-                                      theta - eta * grads, theta)
-                new = jnp.concatenate([theta_new, cg_new], axis=1)
-                accum = jax.tree.map(
-                    lambda a, g: jnp.sqrt(a * a + g * g), accum, g_mlp)
-                mlp_new = jax.tree.map(
-                    lambda p, g, a: p - cfg.lr_alpha_dense
-                    / (cfg.lr_beta + a) * g, mlp, g_mlp, accum)
-                num_ex = jnp.sum(row_mask)
-                acc = accuracy(labels, margin, row_mask)
-                pos, neg = margin_hist(labels, margin, row_mask)
-                d0 = theta_new[:, 0] - theta[:, 0]
-                packed = jnp.concatenate([
-                    jnp.stack([objv, num_ex, acc, jnp.sum(d0 * d0)]),
-                    pos, neg])
-                # num_ex = completion ticket; the clock/macc outputs are
-                # donated into the next step (see ShardedStore._tile_step)
-                return (new.astype(slots.dtype), mlp_new, accum, t + 1,
-                        macc + packed, num_ex)
+                return finish(slots, s32, mlp, accum, push, g_mlp,
+                              margin, labels, row_mask, t, macc)
         else:
             @jax.jit
             def step(slots, mlp, block):
@@ -301,8 +330,13 @@ class WideDeepStore(TableCheckpoint):
             self._tile_cache = {}
         if not hasattr(self, "_tile_kernel"):
             self._tile_kernel = {}
-        self._tile_kernel[key] = (
-            "split", "eval is forward-only" if kind != "train" else why)
+        if kind != "train":
+            self._tile_kernel[key] = (
+                "split", "eval is forward-only",
+                "onehot_cache=off:eval is forward-only")
+        else:
+            self._tile_kernel[key] = ("fused" if fused else "split",
+                                      res.why, res.cache_record)
         self.step_kernel = self._tile_kernel[key]
         self._tile_cache[key] = step
         return step
@@ -479,10 +513,18 @@ class WideDeepStore(TableCheckpoint):
         (fetch_metrics, same harvest pipeline as ShardedStore). Returns
         the non-donated completion ticket, never the clock."""
         step = self._tile_step(info, "train")
-        (self.slots, self.mlp, self.mlp_accum, t_new, self._macc,
-         ticket) = step(self.slots, self.mlp, self.mlp_accum, block,
-                        self._t_device(), self._tau_const(tau),
-                        self._macc_buf())
+        if self.step_kernel[0] == "fused":
+            from wormhole_tpu.obs import trace
+            with trace.span("tilemm:mlp_phase", cat="tile"):
+                (self.slots, self.mlp, self.mlp_accum, t_new, self._macc,
+                 ticket) = step(self.slots, self.mlp, self.mlp_accum,
+                                block, self._t_device(),
+                                self._tau_const(tau), self._macc_buf())
+        else:
+            (self.slots, self.mlp, self.mlp_accum, t_new, self._macc,
+             ticket) = step(self.slots, self.mlp, self.mlp_accum, block,
+                            self._t_device(), self._tau_const(tau),
+                            self._macc_buf())
         self._advance_t(t_new)
         return ticket
 
@@ -563,14 +605,16 @@ def main(argv=None) -> int:
                            val.replace(",", " ").split() if p)
         else:
             rest.append(a)
-    shared = {"num_buckets", "loss", "seed", "tile_step_kernel"}
+    shared = {"num_buckets", "loss", "seed", "tile_step_kernel",
+              "tile_onehot_cache"}
     model_keys = {f.name for f in _dc.fields(WideDeepConfig)} - shared
     model_kvs = [a for a in rest
                  if a.partition("=")[0].strip() in model_keys]
     cfg = load_config(conf, [a for a in rest if a not in model_kvs])
     mcfg = WideDeepConfig(num_buckets=cfg.num_buckets,
                           loss=cfg.loss.value, seed=cfg.seed,
-                          tile_step_kernel=cfg.tile_step_kernel)
+                          tile_step_kernel=cfg.tile_step_kernel,
+                          tile_onehot_cache=cfg.tile_onehot_cache)
     apply_kvs(mcfg, model_kvs)
     if hidden is not None:
         mcfg.hidden = hidden

@@ -110,6 +110,11 @@ SPAN_TABLE: Dict[str, str] = {
     # pallas dispatch, so the span is pure device work
     "tilemm:fused_step": "device_compute",
     "tilemm:fused_multi": "device_compute",
+    # fused-grid variants: the phase-shared one-hot cache replays the
+    # staged planes in phase 2, and the wide&deep MLP forward/vjp runs
+    # at the phase boundary — both still one pallas dispatch
+    "tilemm:fused_cached": "device_compute",
+    "tilemm:mlp_phase": "device_compute",
     # online serving (serve/): the pull-only forward is device work;
     # the snapshot hot-swap is a reference assignment outside any step
     "serve:forward": "device_compute",

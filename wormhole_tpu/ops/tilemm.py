@@ -272,6 +272,22 @@ def _oh_rep(rep: jax.Array, shift: int, mask: int, n: int,
     return ((rep & (mask << shift)) == iota).astype(jnp.bfloat16)
 
 
+def _digit_cond(rep: jax.Array, shift: int, mask: int, n: int,
+                width: int) -> jax.Array:
+    """(n, width) bool digit compare of the sublane-replicated packed
+    word against a pre-shifted iota — the compare half of _mask_sel,
+    split out so the fused grid's one-hot cache can stage the plane in
+    phase 1 and replay it in phase 2 instead of rebuilding it."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1) << shift
+    return (rep & (mask << shift)) == iota
+
+
+def _sel_cond(cond: jax.Array, x: jax.Array) -> jax.Array:
+    """The select half of _mask_sel: the f32->bf16 convert runs BEFORE
+    the select so the select touches half the vregs."""
+    return jnp.where(cond, x.astype(jnp.bfloat16), jnp.bfloat16(0))
+
+
 def _mask_sel(rep: jax.Array, shift: int, mask: int,
               x: jax.Array) -> jax.Array:
     """x masked by a digit one-hot, as one in-place compare + a bf16
@@ -279,9 +295,7 @@ def _mask_sel(rep: jax.Array, shift: int, mask: int,
     touches half the vregs, and the field compares in place (no shift
     pass) — two fewer VPU passes per site than cmp/sel-f32/convert."""
     n, width = x.shape
-    iota = jax.lax.broadcasted_iota(jnp.int32, (n, width), 1) << shift
-    cond = (rep & (mask << shift)) == iota
-    return jnp.where(cond, x.astype(jnp.bfloat16), jnp.bfloat16(0))
+    return _sel_cond(_digit_cond(rep, shift, mask, n, width), x)
 
 
 def _ohT_vec(vec: jax.Array, shift: int, mask: int, width: int,
@@ -337,6 +351,95 @@ def _fwd_kernel(spec: TileSpec, pw_ref, w_ref, mg_ref, t=None):
                                   preferred_element_type=jnp.float32)
         for j in range(GS):
             mg_ref[g * GS + j] = mgs[j]
+
+
+def _fwd_kernel_cached(spec: TileSpec, pw_ref, w_ref, mg_ref,
+                       rep_c, lo_c, rlo_c, t):
+    """_fwd_kernel staging the one-hot cache as it computes: the
+    packed-word lanes->sublanes relayout (rep) and the lo/rlo digit
+    compare planes it already builds per (group, tile) are written to
+    full-tile-set VMEM scratch so phase 2 replays them instead of
+    rebuilding (the round-5 floor model charges the residual VPU time
+    to exactly these rebuilds, docs/perf.md round 8). The compute is
+    bitwise IDENTICAL to _fwd_kernel — the staged planes are the same
+    booleans the uncached body folds into its selects. Only used from
+    the fused step grid, which passes its own grid index ``t``."""
+    @pl.when(t == 0)
+    def _():
+        mg_ref[:] = jnp.zeros_like(mg_ref)
+
+    S, GS, C, N = spec.subblocks, spec.group, spec.cap, spec.n
+    TB = spec.tiles_step
+    ones_pick = jnp.ones((B_LO, RL), jnp.bfloat16)
+    for g in range(S // GS):
+        mgs = [mg_ref[g * GS + j] for j in range(GS)]
+        for tb in range(TB):
+            wt = w_ref[tb]                                 # (128,128) bf16
+            pc = pw_ref[tb, g].astype(jnp.int32)           # (N,)
+            rep = pc[:, None]                              # ONE relayout
+            cond_lo = _digit_cond(rep, LO_SH, LO_M, N, B_LO)
+            cond_rlo = _digit_cond(rep, RLO_SH, RLO_M, N, RL)
+            # stage at the GLOBAL tile index: phase 2's grid step nt+j
+            # re-visits pairs block j, so nothing is evictable at the
+            # phase boundary and the cache spans all T tiles (this is
+            # what onehot_cache_bytes budgets against VMEM)
+            rep_c[t * TB + tb, g] = rep
+            lo_c[t * TB + tb, g] = cond_lo.astype(jnp.bfloat16)
+            rlo_c[t * TB + tb, g] = cond_rlo.astype(jnp.bfloat16)
+            ohhi = _oh_rep(rep, HI_SH, HI_M, N, 128)       # pad -> 0 row
+            m = jnp.dot(ohhi, wt, preferred_element_type=jnp.float32)
+            wp = jnp.dot(_sel_cond(cond_lo, m), ones_pick,
+                         preferred_element_type=jnp.float32)
+            rhs = _sel_cond(cond_rlo, wp)                  # (N, RL)
+            for j in range(GS):
+                rhiT = _ohT_vec(pc[j * C:(j + 1) * C],
+                                RHI_SH, RHI_M, RH, C)
+                mgs[j] += jnp.dot(rhiT, rhs[j * C:(j + 1) * C],
+                                  preferred_element_type=jnp.float32)
+        for j in range(GS):
+            mg_ref[g * GS + j] = mgs[j]
+
+
+def _bwd_kernel_cached(spec: TileSpec, pw_ref, dual_ref, g_ref,
+                       rep_c, lo_c, rlo_c, tj):
+    """_bwd_kernel replaying the phase-1 one-hot cache: the packed-word
+    relayout and the lo/rlo compare planes load from VMEM instead of
+    being rebuilt — only the joint subblock-parity digit (ohghi, a
+    bwd-only layout) and the lanes-native histogram lhs (ohhiT, no
+    relayout to save) are still built here. The staged bf16 0/1 planes
+    recover the original booleans exactly (``!= 0``), so the selects —
+    and therefore the emitted grads — stay bitwise-identical to the
+    uncached body. ``tj`` is the phase-2 step index (t - nt)."""
+    S, GS, C = spec.subblocks, spec.group, spec.cap
+    TB = spec.tiles_step
+    bp = _bp(spec)
+    NC = bp * C
+    ones_bcast = jnp.ones((RL, B_LO), jnp.bfloat16)
+    offs = (jax.lax.broadcasted_iota(jnp.int32, (NC, 1), 0) // C) * RH
+    iota_ghi_sh = ((jax.lax.broadcasted_iota(jnp.int32, (NC, bp * RH), 1)
+                    - offs) << RHI_SH)
+    for tb in range(TB):
+        acc = jnp.zeros((A_HI, B_LO), jnp.float32)
+        for g in range(S // GS):
+            rep_g = rep_c[tj * TB + tb, g]                 # (N, 1) i32
+            lo_g = lo_c[tj * TB + tb, g]                   # (N, 128) 0/1
+            rlo_g = rlo_c[tj * TB + tb, g]                 # (N, 128) 0/1
+            for h in range(GS // bp):
+                sp = (g * GS) // bp + h
+                sl = slice(h * NC, (h + 1) * NC)
+                pc = pw_ref[tb, g, sl].astype(jnp.int32)
+                rep = rep_g[sl]
+                ohghi = ((rep & (RHI_M << RHI_SH))
+                         == iota_ghi_sh).astype(jnp.bfloat16)
+                md = jnp.dot(ohghi, dual_ref[sp],
+                             preferred_element_type=jnp.float32)
+                dp = jnp.dot(_sel_cond(rlo_g[sl] != 0, md), ones_bcast,
+                             preferred_element_type=jnp.float32)
+                rhs = _sel_cond(lo_g[sl] != 0, dp)         # (NC, 128)
+                ohhiT = _ohT_vec(pc, HI_SH, HI_M, A_HI, NC)
+                acc += jnp.dot(ohhiT, rhs,
+                               preferred_element_type=jnp.float32)
+        g_ref[tb] = acc
 
 
 # ---------------------------------------------------------------------------
@@ -761,6 +864,65 @@ def _build_bwd_multi(spec: TileSpec, ch: int):
     return bwd
 
 
+# -- COO spill helpers -------------------------------------------------------
+#
+# One shared aggregation for both step formulations: the spill pairs are
+# pre-aggregated into a zero row grid, and the kernel margins/pulls get
+# ONE elementwise add of that grid — in XLA on the split path, at the
+# phase boundary (as an operand) on the fused path. Pre-aggregating is
+# what makes the fused path possible at all (the boundary phase cannot
+# run a scatter), and doing it on BOTH paths keeps them bitwise-equal
+# even when several spills share a row. The grad-side scatters need the
+# grad/push in HBM, so they stay in XLA on every path — the fused
+# callers recompute the dual from the emitted margins (elementwise,
+# bitwise-equal) and land in the same shared helper.
+
+def spill_margin_rows(w: jax.Array, ovf_b: jax.Array, ovf_r: jax.Array,
+                      spec: TileSpec) -> jax.Array:
+    """(block_rows,) f32 pre-aggregated spill margins: each valid COO
+    pair's w lands on its row (0xFFFFFFFF-sentinel slots add 0)."""
+    valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+    wv = jnp.where(valid, w[jnp.where(valid, ovf_b, 0).astype(jnp.int32)],
+                   0.0)
+    return jnp.zeros(spec.block_rows, w.dtype).at[
+        ovf_r.astype(jnp.int32) % spec.block_rows].add(wv)
+
+
+def spill_pull_rows(w: jax.Array, ovf_b: jax.Array, ovf_r: jax.Array,
+                    spec: TileSpec) -> jax.Array:
+    """(block_rows, ch) multi-channel variant of spill_margin_rows."""
+    valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+    idx = jnp.where(valid, ovf_b, 0).astype(jnp.int32)
+    wv = jnp.where(valid[:, None], w[idx], 0.0)
+    return jnp.zeros((spec.block_rows, w.shape[1]), w.dtype).at[
+        ovf_r.astype(jnp.int32) % spec.block_rows].add(wv)
+
+
+def spill_grad_scatter(g: jax.Array, dual_rows: jax.Array,
+                       ovf_b: jax.Array, ovf_r: jax.Array,
+                       spec: TileSpec) -> jax.Array:
+    """Scatter each spill pair's dual into the (nb,) gradient — the
+    grad-side COO tail shared by backward_grad and the fused spill
+    branch."""
+    valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+    d = jnp.where(valid,
+                  dual_rows[ovf_r.astype(jnp.int32) % spec.block_rows],
+                  0.0)
+    return g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
+
+
+def spill_push_scatter(g: jax.Array, dual_rows: jax.Array,
+                       ovf_b: jax.Array, ovf_r: jax.Array,
+                       spec: TileSpec) -> jax.Array:
+    """(nb, ch) variant of spill_grad_scatter (backward_pushes' tail
+    and the fused FM spill branch)."""
+    valid = ovf_b != jnp.uint32(0xFFFFFFFF)
+    d = jnp.where(valid[:, None],
+                  dual_rows[ovf_r.astype(jnp.int32) % spec.block_rows],
+                  0.0)
+    return g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
+
+
 def forward_pulls(pw: jax.Array, w: jax.Array, spec: TileSpec,
                   ovf_b: Optional[jax.Array] = None,
                   ovf_r: Optional[jax.Array] = None) -> jax.Array:
@@ -770,10 +932,7 @@ def forward_pulls(pw: jax.Array, w: jax.Array, spec: TileSpec,
     ch = w.shape[1]
     pulls = _build_fwd_multi(spec, ch)(pw, w)
     if ovf_b is not None and ovf_b.shape[0]:
-        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
-        idx = jnp.where(valid, ovf_b, 0).astype(jnp.int32)
-        wv = jnp.where(valid[:, None], w[idx], 0.0)
-        pulls = pulls.at[ovf_r.astype(jnp.int32) % spec.block_rows].add(wv)
+        pulls = pulls + spill_pull_rows(w, ovf_b, ovf_r, spec)
     return pulls
 
 
@@ -785,11 +944,7 @@ def backward_pushes(pw: jax.Array, dual_rows: jax.Array, spec: TileSpec,
     ch = dual_rows.shape[1]
     g = _build_bwd_multi(spec, ch)(pw, dual_rows)
     if ovf_b is not None and ovf_b.shape[0]:
-        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
-        d = jnp.where(valid[:, None],
-                      dual_rows[ovf_r.astype(jnp.int32) % spec.block_rows],
-                      0.0)
-        g = g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
+        g = spill_push_scatter(g, dual_rows, ovf_b, ovf_r, spec)
     return g
 
 
@@ -823,47 +978,169 @@ def backward_pushes(pw: jax.Array, dual_rows: jax.Array, spec: TileSpec,
 # the split path a bit-parity oracle: both paths run the same bf16
 # one-hot matmuls over the same blocks in the same order, and the dual/
 # update math is elementwise — tests assert margins, grads, and post-
-# update slots bitwise-equal in interpret mode. The COO spill path
-# cannot fuse (its scatter adds margins between the fwd pass and the
-# dual, outside any kernel), so resolve_step_kernel falls back to split
-# whenever ovf_cap > 0 — likewise on the mesh path, where psums over
-# MODEL (margins) and DATA (grads) sit at exactly the two seams the
-# fusion removes.
+# update slots bitwise-equal in interpret mode. COO spill blocks fuse
+# too: the spill margins are pre-aggregated to a row grid in XLA
+# (spill_margin_rows) and enter the grid as one extra operand the
+# boundary phase adds before the dual — the same elementwise add the
+# split forward_margins runs, so parity survives (only the grad-side
+# scatter stays in XLA, where the dual recomputed from the emitted
+# margins is bitwise-equal). Wide&deep fuses by running the MLP
+# forward/vjp at the boundary (a dense third phase between the
+# embedding pulls and pushes), budgeted against VMEM below. Only the
+# mesh path stays structurally split: psums over MODEL (margins) and
+# DATA (grads) sit at exactly the two seams the fusion removes.
+#
+# On top of the fusion, the ONE-HOT CACHE (tile_onehot_cache) removes
+# the last duplicated work: phase 2 used to rebuild the packed-word
+# relayout and the lo/rlo digit compare planes phase 1 built moments
+# earlier for the same tiles. The cached kernel variants stage them in
+# VMEM scratch (phase 1) and replay them (phase 2) — admitted by an
+# explicit budget model, since the planes must persist for ALL tiles
+# across the phase boundary.
 
 STEP_KERNELS = ("auto", "fused", "split")
+ONEHOT_CACHES = ("auto", "on", "off")
+
+# VMEM budget model for the fused-step extras. The kernels request
+# vmem_limit_bytes=100MB; the round-5 floor model puts the fused scalar
+# step's resident working set at ~704 vregs (pairs + weight tile +
+# margin grid + dual scratch + the value-chain intermediates), and
+# anything added on top — the one-hot cache planes, the wide&deep MLP
+# phase activations — must fit in the remainder.
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+WORKING_SET_VREGS = 704
+_VREG_BYTES = 8 * 128 * 4
+VMEM_EXTRA_BUDGET = VMEM_LIMIT_BYTES - WORKING_SET_VREGS * _VREG_BYTES
+
+
+def onehot_cache_bytes(spec: TileSpec) -> int:
+    """Bytes of the phase-shared one-hot cache: per (tile, group) the
+    staged planes are the (N, 1) i32 packed-word relayout and two
+    (N, 128) bf16 digit compare planes, held for the FULL tile set
+    (phase 2's grid step nt+j revisits pairs block j, so nothing is
+    evictable at the phase boundary)."""
+    SG = spec.subblocks // spec.group
+    return spec.tiles * SG * spec.n * (4 + 2 * B_LO + 2 * RL)
+
+
+def mlp_phase_bytes(spec: TileSpec, dim: int, hidden: Tuple[int, ...]
+                    ) -> int:
+    """VMEM bytes the wide&deep boundary phase holds live: the pulls
+    (f32) and dual (bf16) channel grids plus the MLP activations the
+    in-kernel vjp keeps across block_rows rows (primal + cotangent,
+    f32, one column per pooled input / hidden unit / output)."""
+    rows = spec.block_rows
+    ch_in, ch_out = 1 + dim, dim + 2
+    grids = rows * (ch_in * 4 + ch_out * 2)
+    acts = rows * (dim + sum(hidden) + 1) * 2 * 4
+    return grids + acts
+
+
+@dataclass(frozen=True)
+class StepResolution:
+    """Structured result of resolve_step_kernel: the resolved kernel,
+    the split reason (empty when fused), and the one-hot cache decision
+    with its off-reason (empty when on). ``cache_record`` is the string
+    store.step_kernel records alongside the split reason."""
+    kernel: str
+    why: str = ""
+    cache: bool = False
+    cache_why: str = ""
+
+    @property
+    def cache_record(self) -> str:
+        return ("onehot_cache=on" if self.cache
+                else f"onehot_cache=off:{self.cache_why}")
+
+
+def _onehot_cache_decision(resolved: str, knob: str,
+                           spec: Optional[TileSpec], channels: int,
+                           deep: bool) -> Tuple[bool, str]:
+    """The cache half of resolve_step_kernel. Structural exclusions
+    (split resolution, multi-channel, K>1 chains) hold even under a
+    forced ``on``; the VMEM budget model only gates ``auto`` — ``on``
+    overrides it so ktune/bench can measure past the model."""
+    if knob == "off":
+        return False, "forced off"
+    if resolved != "fused":
+        return False, "split path shares no phases"
+    if channels > 1 or deep:
+        return False, ("multi-channel kernels hoist one wide compare "
+                       "across channels; no per-phase rebuild to stage")
+    if spec is None:
+        return False, "no tile spec at resolve time"
+    if spec.fuse > 1:
+        return False, ("fuse>1 re-views pairs into K-tile chains; the "
+                       "staged planes do not align with the bwd view")
+    if knob == "on":
+        return True, ""
+    need = onehot_cache_bytes(spec)
+    if need > VMEM_EXTRA_BUDGET:
+        return False, (f"cache planes need ~{need // 2**20} MB, over "
+                       f"the {VMEM_EXTRA_BUDGET // 2**20} MB left "
+                       f"beside the {WORKING_SET_VREGS}-vreg working "
+                       f"set")
+    return True, ""
 
 
 def resolve_step_kernel(kernel: str, *, ovf_cap: int = 0,
-                        mesh: bool = False,
-                        deep: bool = False) -> Tuple[str, str]:
-    """Resolve the ``tile_step_kernel`` knob to ``("fused"|"split",
-    why)`` — ``why`` names the reason whenever the resolution is split.
-    Structural inadmissibility (spill, mesh, an MLP between pulls and
-    pushes) wins over a forced ``fused``: unlike ``tile_online=on``
-    this never raises, because ovf_cap is a property of the dataset,
-    not a misconfiguration. ``auto`` resolves to fused only on the TPU
-    backend (mirroring ``gbdt_hist_kernel``); a forced ``fused`` runs
-    anywhere — interpret mode included, which is how the CPU parity
-    tests drive it."""
+                        mesh: bool = False, deep: bool = False,
+                        spec: Optional[TileSpec] = None,
+                        onehot_cache: str = "auto", dim: int = 0,
+                        hidden: Tuple[int, ...] = (),
+                        channels: int = 1) -> StepResolution:
+    """Resolve the ``tile_step_kernel`` + ``tile_onehot_cache`` knobs
+    to a :class:`StepResolution` — ``why`` names the reason whenever
+    the resolution is split, ``cache_why`` whenever the one-hot cache
+    is off. Structural inadmissibility (mesh, an over-VMEM-budget MLP
+    phase, wide&deep spill) wins over a forced ``fused``: unlike
+    ``tile_online=on`` this never raises, because ovf_cap and the
+    model geometry are properties of the dataset, not misconfiguration.
+    ``auto`` resolves to fused only on the TPU backend (mirroring
+    ``gbdt_hist_kernel``); a forced ``fused`` runs anywhere —
+    interpret mode included, which is how the CPU parity tests drive
+    it. Callers pass ``spec`` (for the VMEM budget models), ``dim`` /
+    ``hidden`` on the wide&deep path, and ``channels`` (pull/push
+    channel count) on any multi-channel path."""
     if kernel not in STEP_KERNELS:
         raise ValueError(f"tile_step_kernel must be one of "
                          f"{STEP_KERNELS}, got {kernel!r}")
-    if ovf_cap > 0:
-        return "split", ("the COO spill scatter adds margins between "
-                         "the fwd pass and the dual, outside any kernel")
+    if onehot_cache not in ONEHOT_CACHES:
+        raise ValueError(f"tile_onehot_cache must be one of "
+                         f"{ONEHOT_CACHES}, got {onehot_cache!r}")
+
+    def res(k: str, why: str = "") -> StepResolution:
+        cache, cwhy = _onehot_cache_decision(k, onehot_cache, spec,
+                                             channels, deep)
+        return StepResolution(k, why, cache, cwhy)
+
     if mesh:
-        return "split", ("mesh psums (margins over model, grads over "
-                         "data) sit between the phases the fusion joins")
+        return res("split", ("mesh psums (margins over model, grads "
+                             "over data) sit between the phases the "
+                             "fusion joins"))
     if deep:
-        return "split", ("an MLP vjp runs between the embedding pulls "
-                         "and the pushes")
+        if ovf_cap > 0:
+            return res("split", ("wide&deep spill needs the pull "
+                                 "channels in HBM for the COO scatter "
+                                 "between the phases"))
+        if spec is None:
+            return res("split", ("no tile spec at resolve time to "
+                                 "budget the in-kernel MLP phase "
+                                 "against VMEM"))
+        need = mlp_phase_bytes(spec, dim, tuple(hidden))
+        if need > VMEM_EXTRA_BUDGET:
+            return res("split", (f"wide&deep MLP phase needs ~"
+                                 f"{need // 2**20} MB of VMEM for the "
+                                 f"dense activations, over the "
+                                 f"{VMEM_EXTRA_BUDGET // 2**20} MB "
+                                 f"left beside the working set"))
     if kernel == "split":
-        return "split", "forced"
+        return res("split", "forced")
     if kernel == "fused":
-        return "fused", ""
+        return res("fused")
     if jax.default_backend() == "tpu":
-        return "fused", ""
-    return "split", f"auto on {jax.default_backend()} backend"
+        return res("fused")
+    return res("split", f"auto on {jax.default_backend()} backend")
 
 
 class _GradSink:
@@ -881,15 +1158,25 @@ class _GradSink:
 
 
 def _make_step_kernel(spec: TileSpec, loss: str, exact_dense: bool,
-                      handle, nt: int):
+                      handle, nt: int, cache: bool = False,
+                      spill: bool = False):
     """Two-phase scalar kernel body; see the section comment.
     ``handle`` is None for the grad-emitting variant or an FTRLHandle
     for the in-place slot update — the kernel calls the handle's own
     ``update`` on the tile planes, so the in-kernel math can never
-    drift from the split path's push()."""
+    drift from the split path's push(). ``cache`` swaps in the one-hot
+    cache kernel bodies (stage in phase 1, replay in phase 2; K == 1
+    only — the resolver enforces the structural exclusions); ``spill``
+    adds a pre-aggregated COO spill-margin grid operand the boundary
+    phase sums in before the dual (grad-emitting variant only: the
+    spill grad scatter needs the grad in HBM, so the in-place update
+    variant never sees spill)."""
     from .loss import create_loss, opaque_one
     _, dual_fn = create_loss(loss)
     K = spec.fuse
+    assert not (cache and K > 1), "one-hot cache excludes K>1 chains"
+    assert not (spill and handle is not None), \
+        "spill blocks use the grad-emitting variant"
 
     def kernel(*refs):
         if K > 1:
@@ -898,22 +1185,40 @@ def _make_step_kernel(spec: TileSpec, loss: str, exact_dense: bool,
         else:
             pw_ref, wt_ref, lab_ref, msk_ref = refs[:4]
             rest = refs[4:]
+        if spill:
+            sp_ref, rest = rest[0], rest[1:]
         if handle is not None:
             (wp_ref, zp_ref, np_ref, mg_ref, wo_ref, zo_ref, no_ref,
-             dual_s) = rest
+             *scr) = rest
         else:
-            mg_ref, g_ref, dual_s = rest
+            mg_ref, g_ref, *scr = rest
+        if cache:
+            dual_s, rep_c, lo_c, rlo_c = scr
+        else:
+            (dual_s,) = scr
         t = pl.program_id(0)
 
         @pl.when(t < nt)
         def _fwd():
-            _fwd_kernel(spec, pw_ref, wt_ref, mg_ref, t)
+            if cache:
+                _fwd_kernel_cached(spec, pw_ref, wt_ref, mg_ref,
+                                   rep_c, lo_c, rlo_c, t)
+            else:
+                _fwd_kernel(spec, pw_ref, wt_ref, mg_ref, t)
 
         @pl.when(t == nt)
         def _dual():
             lab = lab_ref[...]
             msk = msk_ref[...]
-            dual = dual_fn(mg_ref[...], lab, msk)
+            mg = mg_ref[...]
+            if spill:
+                # the pre-aggregated spill grid lands on the margins
+                # BEFORE the dual — the same elementwise add the split
+                # path's forward_margins runs in XLA, so the emitted
+                # margins (and the dual) stay bitwise-identical
+                mg = mg + sp_ref[...]
+                mg_ref[...] = mg
+            dual = dual_fn(mg, lab, msk)
             if not exact_dense:
                 # _nudge_zero_dual (learners/store.py), elementwise —
                 # same bits as the split path's XLA nudge
@@ -925,14 +1230,20 @@ def _make_step_kernel(spec: TileSpec, loss: str, exact_dense: bool,
         @pl.when(t >= nt)
         def _bwd():
             if handle is None:
-                if K > 1:
+                if cache:
+                    _bwd_kernel_cached(spec, pw_ref, dual_s, g_ref,
+                                       rep_c, lo_c, rlo_c, t - nt)
+                elif K > 1:
                     _bwd_kernel_fused(spec, pwk_ref, dual_s, ghic_ref,
                                       g_ref)
                 else:
                     _bwd_kernel(spec, pw_ref, dual_s, g_ref)
                 return
             sink = _GradSink()
-            if K > 1:
+            if cache:
+                _bwd_kernel_cached(spec, pw_ref, dual_s, sink,
+                                   rep_c, lo_c, rlo_c, t - nt)
+            elif K > 1:
                 _bwd_kernel_fused(spec, pwk_ref, dual_s, ghic_ref, sink)
             else:
                 _bwd_kernel(spec, pw_ref, dual_s, sink)
@@ -948,13 +1259,14 @@ def _make_step_kernel(spec: TileSpec, loss: str, exact_dense: bool,
     return kernel
 
 
-def _step_grid_specs(spec: TileSpec):
+def _step_grid_specs(spec: TileSpec, spill: bool = False):
     """(grid, in_specs, nt) shared by both fused scalar variants: pairs
     + bf16 weight tiles stream through phase 1 (and, at K == 1, phase 2
     re-streams the pairs exactly as the split bwd call would), the
     label/mask grids sit at a constant index, and the K > 1 variant
     adds the re-viewed pairs + the joint-digit compare constant for
-    _bwd_kernel_fused."""
+    _bwd_kernel_fused. ``spill`` appends the constant-index
+    pre-aggregated spill-margin grid the boundary phase consumes."""
     T, TB, K = spec.tiles, spec.tiles_step, spec.fuse
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
     GS = spec.group
@@ -974,7 +1286,20 @@ def _step_grid_specs(spec: TileSpec):
                          lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
             pl.BlockSpec((K * N, GS * RH), lambda t: (0, 0)),
         ]
+    if spill:
+        in_specs += [pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0))]
     return (2 * nt,), in_specs, nt
+
+
+def _cache_scratch(spec: TileSpec):
+    """The one-hot cache's VMEM scratch: the packed-word relayout
+    column and the two digit compare planes, for every (tile, group) —
+    the shapes onehot_cache_bytes budgets."""
+    T = spec.tiles
+    SG, N = spec.subblocks // spec.group, spec.n
+    return [pltpu.VMEM((T, SG, N, 1), jnp.int32),
+            pltpu.VMEM((T, SG, N, B_LO), jnp.bfloat16),
+            pltpu.VMEM((T, SG, N, RL), jnp.bfloat16)]
 
 
 def _step_dual_scratch(spec: TileSpec):
@@ -1000,21 +1325,26 @@ def _step_extra_args(pw, spec: TileSpec):
 
 
 @lru_cache(maxsize=None)
-def _build_step_grad(spec: TileSpec, loss: str, exact_dense: bool):
+def _build_step_grad(spec: TileSpec, loss: str, exact_dense: bool,
+                     cache: bool = False, spill: bool = False):
     """Fused step, grad-emitting variant: (margins, grad) with the dual
     grid never materialized in HBM. The handle update stays in XLA —
     the multihost path (gradients cross the wire before the update) and
-    every non-FTRL handle."""
+    every non-FTRL handle. ``spill`` takes the pre-aggregated spill-
+    margin grid as a trailing operand (the grad-side scatter stays with
+    the caller, where the grad lives in HBM anyway)."""
     T, TB = spec.tiles, spec.tiles_step
     S = spec.subblocks
-    grid, in_specs, nt = _step_grid_specs(spec)
-    kernel = _make_step_kernel(spec, loss, exact_dense, None, nt)
+    grid, in_specs, nt = _step_grid_specs(spec, spill=spill)
+    kernel = _make_step_kernel(spec, loss, exact_dense, None, nt,
+                               cache=cache, spill=spill)
 
     @jax.jit
-    def step(pw, w, labels, mask):
+    def step(pw, w, labels, mask, *spill_rows):
         wt = w.reshape(T, A_HI, B_LO).astype(jnp.bfloat16)
-        args = [pw, wt, labels.reshape(S, RH, RL),
-                mask.reshape(S, RH, RL)] + _step_extra_args(pw, spec)
+        args = ([pw, wt, labels.reshape(S, RH, RL),
+                 mask.reshape(S, RH, RL)] + _step_extra_args(pw, spec)
+                + [s.reshape(S, RH, RL) for s in spill_rows])
         mg, g = pl.pallas_call(
             kernel,
             grid=grid,
@@ -1028,7 +1358,8 @@ def _build_step_grad(spec: TileSpec, loss: str, exact_dense: bool):
                 jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
                 jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
             ],
-            scratch_shapes=[_step_dual_scratch(spec)],
+            scratch_shapes=([_step_dual_scratch(spec)]
+                            + (_cache_scratch(spec) if cache else [])),
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
@@ -1039,7 +1370,8 @@ def _build_step_grad(spec: TileSpec, loss: str, exact_dense: bool):
 
 
 @lru_cache(maxsize=None)
-def _build_step_update(spec: TileSpec, loss: str, handle):
+def _build_step_update(spec: TileSpec, loss: str, handle,
+                       cache: bool = False):
     """Fused step, in-place FTRL variant: (margins, new_slots32). The
     w/z/cg planes enter as operands aliased onto the outputs, so the
     (nb,) gradient never exists in HBM — each tile's grad goes straight
@@ -1050,7 +1382,7 @@ def _build_step_update(spec: TileSpec, loss: str, handle):
     T, TB = spec.tiles, spec.tiles_step
     S = spec.subblocks
     grid, in_specs, nt = _step_grid_specs(spec)
-    kernel = _make_step_kernel(spec, loss, True, handle, nt)
+    kernel = _make_step_kernel(spec, loss, True, handle, nt, cache=cache)
     n_in = len(in_specs)
     plane = pl.BlockSpec((TB, A_HI, B_LO),
                          lambda t: (jnp.maximum(t - nt, 0), 0, 0))
@@ -1079,7 +1411,8 @@ def _build_step_update(spec: TileSpec, loss: str, handle):
                 jax.ShapeDtypeStruct((T, A_HI, B_LO), jnp.float32),
             ],
             input_output_aliases={n_in: 1, n_in + 1: 2, n_in + 2: 3},
-            scratch_shapes=[_step_dual_scratch(spec)],
+            scratch_shapes=([_step_dual_scratch(spec)]
+                            + (_cache_scratch(spec) if cache else [])),
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
@@ -1104,19 +1437,30 @@ def fm_margin_math(lin, s_parts, q, one):
 
 
 def _make_fm_step_kernel(spec: TileSpec, ch: int, k: int, loss: str,
-                         nt: int):
+                         nt: int, spill: bool = False):
     """Two-phase multi-channel kernel body for the FM step: phase 1 is
     the unmodified _fwd_multi_kernel accumulating the (S, RH, ch*RL)
     pulls grid in VMEM scratch (it never reaches HBM at all); the
     boundary computes the FM margin (lin + 0.5*(Σ s_j² − q), summed
     sequentially — the split path mirrors the same order), the dual,
     and the [dual, dual*s_j..., mask] push channels; phase 2 is the
-    unmodified _bwd_multi_kernel."""
+    unmodified _bwd_multi_kernel. ``spill`` adds (a) a pre-aggregated
+    COO spill-pulls grid operand summed into the pulls before the
+    margin (the same elementwise add the split forward_pulls runs) and
+    (b) an extra f32 output carrying the dual-channel grid, so the
+    caller can run the spill push scatter in XLA — in-kernel it is
+    bitwise what the split path's XLA dvals would be."""
     from .loss import create_loss, opaque_one
     _, dual_fn = create_loss(loss)
 
-    def kernel(pw_ref, wt_ref, lab_ref, msk_ref, mg_ref, push_ref,
-               pulls_s, dual_s):
+    def kernel(*refs):
+        pw_ref, wt_ref, lab_ref, msk_ref = refs[:4]
+        rest = refs[4:]
+        if spill:
+            sp_ref, rest = rest[0], rest[1:]
+            mg_ref, push_ref, dv_ref, pulls_s, dual_s = rest
+        else:
+            mg_ref, push_ref, pulls_s, dual_s = rest
         t = pl.program_id(0)
 
         @pl.when(t < nt)
@@ -1126,6 +1470,8 @@ def _make_fm_step_kernel(spec: TileSpec, ch: int, k: int, loss: str,
         @pl.when(t == nt)
         def _dual():
             pulls = pulls_s[...]                   # (S, RH, ch*RL)
+            if spill:
+                pulls = pulls + sp_ref[...]
             msk = msk_ref[...]
             one = opaque_one(msk[0, 0, 0])
             s_parts = [pulls[..., (1 + j) * RL:(2 + j) * RL]
@@ -1141,6 +1487,8 @@ def _make_fm_step_kernel(spec: TileSpec, ch: int, k: int, loss: str,
                                           (2 + j) * RL])
             parts.append(msk)                      # touched-count channel
             dv = jnp.concatenate(parts, axis=-1)   # (S, RH, ch*RL)
+            if spill:
+                dv_ref[...] = dv
             dual_s[...] = dv.reshape(dual_s.shape).astype(jnp.bfloat16)
 
         @pl.when(t >= nt)
@@ -1151,39 +1499,56 @@ def _make_fm_step_kernel(spec: TileSpec, ch: int, k: int, loss: str,
 
 
 @lru_cache(maxsize=None)
-def _build_fm_step_fused(spec: TileSpec, k: int, loss: str):
+def _build_fm_step_fused(spec: TileSpec, k: int, loss: str,
+                         spill: bool = False):
     ch = k + 2
     spec = _multi_spec(spec, ch)       # same compile-budget rule as split
     T, TB = spec.tiles, spec.tiles_step
     SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
     bp = _bp(spec)
     nt = T // TB
-    kernel = _make_fm_step_kernel(spec, ch, k, loss, nt)
+    kernel = _make_fm_step_kernel(spec, ch, k, loss, nt, spill=spill)
+    const_grid = pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0))
+    const_wide = pl.BlockSpec((S, RH, ch * RL), lambda t: (0, 0, 0))
 
     @jax.jit
-    def step(pw, wpull, labels, mask):
+    def step(pw, wpull, labels, mask, *spill_pulls):
         # (nb, ch) -> (T, A_HI, ch*B_LO): channel-major contiguous lanes
         wt = (wpull.reshape(T, A_HI, B_LO, ch).transpose(0, 1, 3, 2)
               .reshape(T, A_HI, ch * B_LO).astype(jnp.bfloat16))
-        mg, push = pl.pallas_call(
+        args = [pw, wt, labels.reshape(S, RH, RL),
+                mask.reshape(S, RH, RL)]
+        in_specs = [
+            pl.BlockSpec((TB, SG, N), lambda t: (t % nt, 0, 0)),
+            pl.BlockSpec((TB, A_HI, ch * B_LO),
+                         lambda t: (jnp.minimum(t, nt - 1), 0, 0)),
+            const_grid, const_grid,
+        ]
+        out_specs = [
+            const_grid,
+            pl.BlockSpec((TB, A_HI, ch * B_LO),
+                         lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
+            jax.ShapeDtypeStruct((T, A_HI, ch * B_LO), jnp.float32),
+        ]
+        if spill:
+            # (rows, ch) pre-aggregated spill pulls -> the channel-major
+            # grid layout the pulls scratch carries
+            sp = (spill_pulls[0].reshape(S, RH, RL, ch)
+                  .transpose(0, 1, 3, 2).reshape(S, RH, ch * RL))
+            args.append(sp)
+            in_specs.append(const_wide)
+            out_specs.append(const_wide)
+            out_shape.append(
+                jax.ShapeDtypeStruct((S, RH, ch * RL), jnp.float32))
+        outs = pl.pallas_call(
             kernel,
             grid=(2 * nt,),
-            in_specs=[
-                pl.BlockSpec((TB, SG, N), lambda t: (t % nt, 0, 0)),
-                pl.BlockSpec((TB, A_HI, ch * B_LO),
-                             lambda t: (jnp.minimum(t, nt - 1), 0, 0)),
-                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
-                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0)),
-                pl.BlockSpec((TB, A_HI, ch * B_LO),
-                             lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
-                jax.ShapeDtypeStruct((T, A_HI, ch * B_LO), jnp.float32),
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
             scratch_shapes=[
                 pltpu.VMEM((S, RH, ch * RL), jnp.float32),
                 pltpu.VMEM((S // bp, bp * RH, ch * RL), jnp.bfloat16),
@@ -1191,11 +1556,183 @@ def _build_fm_step_fused(spec: TileSpec, k: int, loss: str):
             compiler_params=None if _interpret() else pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=_interpret(),
-        )(pw, wt, labels.reshape(S, RH, RL), mask.reshape(S, RH, RL))
+        )(*args)
+        mg, push = outs[0], outs[1]
         # (T, A_HI, ch*B_LO) channel-major lanes -> (nb, ch)
         pushes = (push.reshape(T, A_HI, ch, B_LO).transpose(0, 1, 3, 2)
                   .reshape(spec.nb, ch))
+        if spill:
+            # dual-channel grid -> (rows, ch), for the caller's XLA
+            # spill push scatter — the inverse of the pulls transpose
+            dv_rows = (outs[2].reshape(S, RH, ch, RL)
+                       .transpose(0, 1, 3, 2).reshape(spec.block_rows, ch))
+            return mg.reshape(spec.block_rows), pushes, dv_rows
         return mg.reshape(spec.block_rows), pushes
+
+    return step
+
+
+def mlp_forward(params: dict, x: jax.Array, n_layers: int) -> jax.Array:
+    """Dense MLP forward on the pooled embeddings (wide&deep's deep
+    tower; models/wide_deep.py re-exports this). Lives here so the
+    fused wd step can run the SAME function — and the same jax.vjp of
+    it — inside the boundary phase: jit-compiled XLA and the in-kernel
+    trace produce bitwise-identical values for the same graph, which
+    is what keeps fused-vs-split parity a hard contract."""
+    h = x
+    for i in range(n_layers):
+        h = h @ params[f"W{i}"] + params[f"b{i}"]
+        if i + 1 < n_layers:
+            h = jax.nn.relu(h)
+    return h[:, 0]
+
+
+def _make_wd_step_kernel(spec: TileSpec, ch_in: int, ch_out: int,
+                         k: int, n_layers: int, loss: str, nt: int):
+    """Three-phase wide&deep kernel body: phase 1 is the unmodified
+    _fwd_multi_kernel accumulating the (S, RH, ch_in*RL) pulls grid in
+    VMEM scratch; the boundary is the DENSE phase — it unpacks the
+    pulls to (rows, ch_in) exactly as the split wrapper does in XLA,
+    runs the MLP forward + vjp on the pooled embeddings (mlp_forward,
+    the same function the split path jits), computes the dual, and
+    packs [dual, g_pooled_j..., mask] back to the channel-major dual
+    grid; phase 2 is the unmodified _bwd_multi_kernel over ch_out push
+    channels. The per-parameter MLP grads leave through constant-index
+    outputs written once at the boundary. No nudge: the split wd path
+    applies none (AdaGrad + explicit touched mask), and parity with it
+    is the contract."""
+    from .loss import create_loss
+    _, dual_fn = create_loss(loss)
+    S = spec.subblocks
+    bp = _bp(spec)
+    rows = spec.block_rows
+
+    def kernel(*refs):
+        pw_ref, wt_ref, lab_ref, msk_ref = refs[:4]
+        p_refs = refs[4:4 + 2 * n_layers]
+        mg_ref, push_ref = refs[4 + 2 * n_layers:6 + 2 * n_layers]
+        g_refs = refs[6 + 2 * n_layers:6 + 4 * n_layers]
+        pulls_s, dual_s = refs[6 + 4 * n_layers:]
+        t = pl.program_id(0)
+
+        @pl.when(t < nt)
+        def _fwd():
+            _fwd_multi_kernel(spec, ch_in, pw_ref, wt_ref, pulls_s, t)
+
+        @pl.when(t == nt)
+        def _mlp():
+            # channel-major grid -> (rows, ch_in): the same unpack the
+            # split _build_fwd_multi wrapper runs in XLA
+            pg = pulls_s[...]
+            pulls = (pg.reshape(S, RH, ch_in, RL).transpose(0, 1, 3, 2)
+                     .reshape(rows, ch_in))
+            mlp = {}
+            for i in range(n_layers):
+                mlp[f"W{i}"] = p_refs[2 * i][...]
+                mlp[f"b{i}"] = p_refs[2 * i + 1][...][0]
+            pooled = pulls[:, 1:]
+            deep_fn = lambda m, x: mlp_forward(m, x, n_layers)
+            deep, vjp = jax.vjp(deep_fn, mlp, pooled)
+            margin = pulls[:, 0] + deep
+            lab = lab_ref[...].reshape(rows)
+            msk = msk_ref[...].reshape(rows)
+            dual = dual_fn(margin, lab, msk)
+            g_mlp, g_pooled = vjp(dual)
+            for i in range(n_layers):
+                g_refs[2 * i][...] = g_mlp[f"W{i}"]
+                g_refs[2 * i + 1][...] = g_mlp[f"b{i}"][None, :]
+            mg_ref[...] = margin.reshape(S, RH, RL)
+            # [dual, g_pooled..., mask] — the exact dvals concat the
+            # split path builds — packed channel-major for phase 2
+            dvals = jnp.concatenate(
+                [dual[:, None], g_pooled, msk[:, None]], axis=1)
+            dv = (dvals.reshape(S // bp, bp * RH, RL, ch_out)
+                  .transpose(0, 1, 3, 2)
+                  .reshape(S // bp, bp * RH, ch_out * RL))
+            dual_s[...] = dv.astype(jnp.bfloat16)
+
+        @pl.when(t >= nt)
+        def _bwd():
+            _bwd_multi_kernel(spec, ch_out, pw_ref, dual_s, push_ref)
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _build_wd_step_fused(spec: TileSpec, k: int,
+                         hidden: Tuple[int, ...], loss: str):
+    """Fused wide&deep step: (margins (rows,), pushes (nb, k+2), g_mlp
+    tree). Both embedding phases run under ONE grid spec sized by the
+    wider channel count (ch_out = k+2) — margins and pushes are
+    tile-sequential accumulations, so they are bitwise-independent of
+    the tiles_step split and match the split wrappers' (differently
+    blocked) results exactly."""
+    ch_in, ch_out = 1 + k, k + 2
+    spec = _multi_spec(spec, ch_out)
+    T, TB = spec.tiles, spec.tiles_step
+    SG, N, S = spec.subblocks // spec.group, spec.n, spec.subblocks
+    bp = _bp(spec)
+    nt = T // TB
+    sizes = [k] + list(hidden) + [1]
+    n_layers = len(sizes) - 1
+    kernel = _make_wd_step_kernel(spec, ch_in, ch_out, k, n_layers,
+                                  loss, nt)
+    const_grid = pl.BlockSpec((S, RH, RL), lambda t: (0, 0, 0))
+
+    @jax.jit
+    def step(pw, wpull, labels, mask, mlp):
+        # (nb, ch_in) -> (T, A_HI, ch_in*B_LO): channel-major lanes
+        wt = (wpull.reshape(T, A_HI, B_LO, ch_in).transpose(0, 1, 3, 2)
+              .reshape(T, A_HI, ch_in * B_LO).astype(jnp.bfloat16))
+        args = [pw, wt, labels.reshape(S, RH, RL),
+                mask.reshape(S, RH, RL)]
+        in_specs = [
+            pl.BlockSpec((TB, SG, N), lambda t: (t % nt, 0, 0)),
+            pl.BlockSpec((TB, A_HI, ch_in * B_LO),
+                         lambda t: (jnp.minimum(t, nt - 1), 0, 0)),
+            const_grid, const_grid,
+        ]
+        g_specs, g_shapes = [], []
+        for i in range(n_layers):
+            a, b = sizes[i], sizes[i + 1]
+            args += [mlp[f"W{i}"], mlp[f"b{i}"][None, :]]
+            in_specs += [pl.BlockSpec((a, b), lambda t: (0, 0)),
+                         pl.BlockSpec((1, b), lambda t: (0, 0))]
+            g_specs += [pl.BlockSpec((a, b), lambda t: (0, 0)),
+                        pl.BlockSpec((1, b), lambda t: (0, 0))]
+            g_shapes += [jax.ShapeDtypeStruct((a, b), jnp.float32),
+                         jax.ShapeDtypeStruct((1, b), jnp.float32)]
+        outs = pl.pallas_call(
+            kernel,
+            grid=(2 * nt,),
+            in_specs=in_specs,
+            out_specs=[
+                const_grid,
+                pl.BlockSpec((TB, A_HI, ch_out * B_LO),
+                             lambda t: (jnp.maximum(t - nt, 0), 0, 0)),
+            ] + g_specs,
+            out_shape=[
+                jax.ShapeDtypeStruct((S, RH, RL), jnp.float32),
+                jax.ShapeDtypeStruct((T, A_HI, ch_out * B_LO),
+                                     jnp.float32),
+            ] + g_shapes,
+            scratch_shapes=[
+                pltpu.VMEM((S, RH, ch_in * RL), jnp.float32),
+                pltpu.VMEM((S // bp, bp * RH, ch_out * RL),
+                           jnp.bfloat16),
+            ],
+            compiler_params=None if _interpret() else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_interpret(),
+        )(*args)
+        mg, push = outs[0], outs[1]
+        g_mlp = {}
+        for i in range(n_layers):
+            g_mlp[f"W{i}"] = outs[2 + 2 * i]
+            g_mlp[f"b{i}"] = outs[3 + 2 * i][0]
+        pushes = (push.reshape(T, A_HI, ch_out, B_LO)
+                  .transpose(0, 1, 3, 2).reshape(spec.nb, ch_out))
+        return mg.reshape(spec.block_rows), pushes, g_mlp
 
     return step
 
@@ -1204,33 +1741,68 @@ def _build_fm_step_fused(spec: TileSpec, k: int, loss: str):
 
 def fused_step_grad(pw: jax.Array, w: jax.Array, labels: jax.Array,
                     mask: jax.Array, spec: TileSpec, loss: str,
-                    exact_dense: bool) -> Tuple[jax.Array, jax.Array]:
+                    exact_dense: bool, cache: bool = False,
+                    spill_margins: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
     """One-grid margins + dual + grad: (margins (block_rows,),
     grad (nb,)), bitwise-identical to forward_margins -> dual_fn
-    [-> nudge] -> backward_grad with no spill. Callers must have
-    resolved the geometry admissible (resolve_step_kernel)."""
-    return _build_step_grad(spec, loss, exact_dense)(pw, w, labels, mask)
+    [-> nudge] -> backward_grad. ``cache`` stages/replays the one-hot
+    planes across the phases (resolve_step_kernel decides; parity is
+    unchanged). ``spill_margins`` is the pre-aggregated spill grid
+    (spill_margin_rows) summed in before the dual — the caller runs
+    spill_grad_scatter on the returned grad with the dual it recomputes
+    from the returned margins (elementwise, so bitwise-equal to the
+    in-kernel dual). Callers must have resolved the geometry admissible
+    (resolve_step_kernel)."""
+    if spill_margins is None:
+        return _build_step_grad(spec, loss, exact_dense, cache)(
+            pw, w, labels, mask)
+    return _build_step_grad(spec, loss, exact_dense, cache, True)(
+        pw, w, labels, mask, spill_margins)
 
 
 def fused_step_update(pw: jax.Array, s32: jax.Array, labels: jax.Array,
                       mask: jax.Array, spec: TileSpec, loss: str,
-                      handle) -> Tuple[jax.Array, jax.Array]:
+                      handle, cache: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
     """One-grid margins + dual + grad + in-place FTRL: (margins,
     new_slots (nb, 3) f32). ``handle`` is the FTRLHandle whose update()
-    runs in-kernel. The gradient never exists in HBM — single-process
-    only (multihost gradients must cross the wire first; use
+    runs in-kernel. The gradient never exists in HBM — single-process,
+    spill-free blocks only (multihost gradients must cross the wire
+    first and spill scatters need the grad in HBM; use
     fused_step_grad)."""
-    return _build_step_update(spec, loss, handle)(pw, s32, labels, mask)
+    return _build_step_update(spec, loss, handle, cache)(
+        pw, s32, labels, mask)
 
 
 def fused_fm_step(pw: jax.Array, wpull: jax.Array, labels: jax.Array,
-                  mask: jax.Array, spec: TileSpec, k: int, loss: str
-                  ) -> Tuple[jax.Array, jax.Array]:
+                  mask: jax.Array, spec: TileSpec, k: int, loss: str,
+                  spill_pulls: Optional[jax.Array] = None):
     """One-grid FM step: (margins (block_rows,), pushes (nb, k+2)) from
     the (nb, k+2) channel table [w, v_j..., Σv²]. Neither the pulls nor
     the dual-channel grid touches HBM; the AdaGrad update stays in XLA
-    (it is elementwise over buckets either way)."""
-    return _build_fm_step_fused(spec, k, loss)(pw, wpull, labels, mask)
+    (it is elementwise over buckets either way). With ``spill_pulls``
+    (the pre-aggregated (rows, k+2) grid from spill_pull_rows) the
+    boundary sums it into the pulls and a third result — the (rows,
+    k+2) dual-channel values — comes back for the caller's XLA
+    spill_push_scatter."""
+    if spill_pulls is None:
+        return _build_fm_step_fused(spec, k, loss)(
+            pw, wpull, labels, mask)
+    return _build_fm_step_fused(spec, k, loss, True)(
+        pw, wpull, labels, mask, spill_pulls)
+
+
+def fused_wd_step(pw: jax.Array, wpull: jax.Array, labels: jax.Array,
+                  mask: jax.Array, mlp: dict, spec: TileSpec, k: int,
+                  hidden: Tuple[int, ...], loss: str):
+    """One-grid wide&deep step: (margins (rows,), pushes (nb, k+2),
+    g_mlp param-grad tree) — the embedding pulls, the in-kernel MLP
+    forward/vjp, the dual, and the pushes in one dispatch. Spill-free
+    blocks only (resolve_step_kernel sends wd spill to split); the
+    sparse/dense updates stay in XLA, identical to the split tail."""
+    return _build_wd_step_fused(spec, k, tuple(hidden), loss)(
+        pw, wpull, labels, mask, mlp)
 
 
 # -- public jit-safe surface (call inside a jitted step) --------------------
@@ -1239,14 +1811,13 @@ def forward_margins(pw: jax.Array, w: jax.Array,
                     spec: TileSpec,
                     ovf_b: Optional[jax.Array] = None,
                     ovf_r: Optional[jax.Array] = None) -> jax.Array:
-    """margins (block_rows,) = sum of w[bucket] over each row's pairs."""
+    """margins (block_rows,) = sum of w[bucket] over each row's pairs.
+    The spill margins come in as ONE pre-aggregated grid add
+    (spill_margin_rows) — the same add the fused boundary phase runs,
+    so the two paths stay bitwise-identical."""
     margins = _build_fwd(spec)(pw, w)
     if ovf_b is not None and ovf_b.shape[0]:
-        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
-        wv = jnp.where(valid, w[jnp.where(valid, ovf_b, 0).astype(jnp.int32)],
-                       0.0)
-        margins = margins.at[ovf_r.astype(jnp.int32) % spec.block_rows].add(
-            wv)
+        margins = margins + spill_margin_rows(w, ovf_b, ovf_r, spec)
     return margins
 
 
@@ -1257,11 +1828,7 @@ def backward_grad(pw: jax.Array, dual_rows: jax.Array,
     """G (nb,) = per-bucket sum of dual over the bucket's pairs."""
     g = _build_bwd(spec)(pw, dual_rows)
     if ovf_b is not None and ovf_b.shape[0]:
-        valid = ovf_b != jnp.uint32(0xFFFFFFFF)
-        d = jnp.where(valid,
-                      dual_rows[ovf_r.astype(jnp.int32) % spec.block_rows],
-                      0.0)
-        g = g.at[jnp.where(valid, ovf_b, 0).astype(jnp.int32)].add(d)
+        g = spill_grad_scatter(g, dual_rows, ovf_b, ovf_r, spec)
     return g
 
 

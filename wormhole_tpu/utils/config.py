@@ -255,9 +255,21 @@ class Config:
     # single-process path) as ONE two-phase pallas grid, so neither the
     # margin grid nor the (nb,) gradient round-trips HBM; "split" keeps
     # the two-call fwd/bwd oracle (the bit-parity reference and the
-    # fallback for spill blocks, mesh shards and deep stores); "auto"
-    # fuses on the TPU backend when the geometry admits it.
+    # structural fallback for mesh shards — spill blocks fuse via a
+    # pre-aggregated margin operand and deep stores via the in-kernel
+    # MLP phase when the VMEM budget admits it); "auto" fuses on the
+    # TPU backend when the geometry admits it.
     tile_step_kernel: str = "auto"
+    # phase-shared one-hot cache inside the fused grid (ops/tilemm.py):
+    # phase 1 stages the per-(group, tile) packed-word relayouts and
+    # digit one-hot planes in VMEM scratch, phase 2 replays them into
+    # the grad-histogram chains instead of rebuilding. "auto" admits the
+    # cache when the plane bytes fit beside the kernel's working set
+    # (resolve_step_kernel's VMEM budget model); "on" forces it past the
+    # budget check (measurement mode — structural exclusions still
+    # hold); "off" always rebuilds. The resolution is recorded as
+    # onehot_cache=on|off:<why> in store.step_kernel.
+    tile_onehot_cache: str = "auto"
     # multi-device crec/crec2 feed (data/crec.MeshGroupFeed): "ring"
     # assembles each data-axis group of D blocks on the pipeline prep
     # workers and device_puts it onto its (data, model) NamedSharding

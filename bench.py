@@ -2350,6 +2350,229 @@ def bench_hierarchy() -> dict:
     return rec
 
 
+def _socket_delta_program(wire, spec: dict) -> dict:
+    """The measured exchange program, IDENTICAL for the socket children
+    and the in-process SimBus baseline: seeded per-rank delta windows
+    allreduced at site ``hier/delta`` (quant8+zlib via the FilterChain),
+    then root-fanned snapshot broadcasts at site ``serve/snapshot``
+    (the lossy-gated op="sum" path the serve fleet ships). The sha256
+    over every reduced/decoded buffer is the tau=0 parity witness: all
+    ranks of both wires must produce the same digest bit-for-bit."""
+    import hashlib
+    import threading
+    from wormhole_tpu.obs import ledger as _ledger
+    from wormhole_tpu.obs import trace as _trace
+    from wormhole_tpu.parallel.filters import FilterChain
+    from wormhole_tpu.parallel.transport import TransportStack
+
+    chain = FilterChain(filters={"key_caching", "fixing_float",
+                                 "compressing"},
+                        quant_bits=8, min_bytes=0)
+    stack = TransportStack(wire=wire, chain=chain)
+    rank = wire.rank()
+    nb, windows = spec["buckets"], spec["windows"]
+    rng = np.random.default_rng(1000 + rank)
+    deltas = [rng.standard_normal(nb).astype(np.float32)
+              for _ in range(windows)]
+    snap_rng = np.random.default_rng(77)
+    snaps = [snap_rng.standard_normal(nb).astype(np.float32)
+             for _ in range(spec["snapshots"])]
+    digest = hashlib.sha256()
+    stack.sync("socket_wire_start")
+    t0 = time.perf_counter()
+    for w in range(windows):
+        red = stack.allreduce(deltas[w], op="sum", site="hier/delta")
+        digest.update(np.asarray(red).tobytes())
+    delta_wall = time.perf_counter() - t0
+    d_raw, d_wire = chain.stats["bytes_raw"], chain.stats["bytes_wire"]
+    t1 = time.perf_counter()
+    for s in snaps:
+        got = stack.broadcast(s, root=0, op="sum", site="serve/snapshot")
+        digest.update(np.asarray(got).tobytes())
+    snap_wall = time.perf_counter() - t1
+    stack.sync("socket_wire_end")
+    wall = time.perf_counter() - t0
+    led = _ledger.build(_trace.events(), wall_s=wall,
+                        tid=threading.get_ident())
+    return {
+        "rank": rank,
+        "digest": digest.hexdigest(),
+        "delta_wall_s": delta_wall,
+        "snap_wall_s": snap_wall,
+        "wall_s": wall,
+        "delta_bytes_raw": d_raw,
+        "delta_bytes_wire": d_wire,
+        "snap_bytes_raw": chain.stats["bytes_raw"] - d_raw,
+        "snap_bytes_wire": chain.stats["bytes_wire"] - d_wire,
+        "collective_wait_s": led["buckets_s"]["collective_wait"],
+        "wire_stats": dict(getattr(wire, "stats", {}) or {}),
+    }
+
+
+def _socket_wire_child(spec_path: str) -> None:
+    """``bench.py --socket-child <spec.json>``: one rank of the real
+    multi-process loopback measurement. Builds a SocketWire from the
+    launcher-style env (PROCESS_ID / NUM_PROCESSES / rendezvous dir),
+    runs the shared program, and commits ``result_r<rank>.json``.
+    Dispatched before argparse/jax so spawn cost stays low."""
+    from wormhole_tpu.ft import watchdog as ft_watchdog
+    from wormhole_tpu.obs import trace as _trace
+    from wormhole_tpu.parallel.socket_wire import SocketWire
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    rank = int(os.environ["PROCESS_ID"])
+    _trace.enable("", ring=1 << 16)
+    # blocking socket reads sit under the same PEER_LOST taxonomy as a
+    # production run: a wedged peer exits this child with 117, and the
+    # parent reports the phase failed instead of hanging
+    ft_watchdog.configure(spec.get("comm_timeout_s", 120.0))
+    wire = SocketWire(outbox_depth=spec.get("outbox_depth", 8),
+                      timeout_s=spec.get("comm_timeout_s", 120.0))
+    try:
+        rec = _socket_delta_program(wire, spec)
+    finally:
+        wire.close()
+    out = os.path.join(spec["dir"], f"result_r{rank}.json")
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, out)
+
+
+def bench_socket_wire() -> dict:
+    """Real socket wire (tentpole of the cross-host-exchange PR): spawn
+    N loopback processes that mesh over TCP through the file/port
+    rendezvous and run seeded delta allreduces + snapshot fan-outs
+    through the full FilterChain stack, then replay the IDENTICAL
+    program over in-process SimBus threads — the deterministic oracle.
+    Reports wire MB/s both ways, the encode/send overlap left by the
+    bounded outbox (1 - collective_wait fraction), and the tau=0
+    digest parity that makes the socket numbers trustworthy: the first
+    ``bytes_wire`` in this repo that crossed a kernel boundary."""
+    import subprocess
+    import sys
+    import threading
+    from wormhole_tpu.obs import trace as _trace
+    from wormhole_tpu.parallel.transport import BusWire, SimBus
+
+    hosts = 2
+    spec = {"buckets": 1 << 16, "windows": 24, "snapshots": 8,
+            "outbox_depth": 8, "comm_timeout_s": 120.0}
+    workdir = tempfile.mkdtemp(prefix="wh_bench_sock_")
+    spec["dir"] = workdir
+    spec_path = os.path.join(workdir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rdv = os.path.join(workdir, "rdv")
+    try:
+        procs = []
+        for r in range(hosts):
+            env = dict(os.environ)
+            env.update({"PROCESS_ID": str(r),
+                        "NUM_PROCESSES": str(hosts),
+                        "WORMHOLE_WIRE_RENDEZVOUS": rdv,
+                        "JAX_PLATFORMS": "cpu",
+                        "PYTHONUNBUFFERED": "1"})
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(repo, "bench.py"),
+                 "--socket-child", spec_path],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        errs = []
+        for r, p in enumerate(procs):
+            try:
+                _out, err = p.communicate(timeout=300.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _out, err = p.communicate()
+                errs.append(f"rank{r}: timeout")
+                continue
+            if p.returncode != 0:
+                errs.append(f"rank{r}: rc={p.returncode}: {err[-400:]}")
+        if errs:
+            raise RuntimeError("socket children failed: " +
+                               "; ".join(errs))
+        sock = []
+        for r in range(hosts):
+            with open(os.path.join(workdir, f"result_r{r}.json")) as f:
+                sock.append(json.load(f))
+
+        # SimBus oracle: same program, same seeds, in-process threads
+        if not _trace.enabled():
+            _trace.enable("", ring=1 << 16)
+        bus = SimBus(hosts)
+        sim: list = [None] * hosts
+        sim_errs: list = []
+
+        def run_sim(h):
+            try:
+                sim[h] = _socket_delta_program(BusWire(bus, h), spec)
+            except Exception as e:
+                sim_errs.append(f"host{h}: {e!r}")
+
+        threads = [threading.Thread(target=run_sim, args=(h,),
+                                    daemon=True) for h in range(hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if sim_errs:
+            raise RuntimeError("; ".join(sim_errs))
+    finally:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    digests = {r["digest"] for r in sock} | {r["digest"] for r in sim}
+    if len(digests) != 1:
+        raise RuntimeError(
+            "socket-vs-sim tau=0 parity BROKEN: "
+            f"socket={[r['digest'][:12] for r in sock]} "
+            f"sim={[r['digest'][:12] for r in sim]}")
+
+    def mbps(recs, bkey, wkey):
+        return (sum(r[bkey] for r in recs)
+                / max(max(r[wkey] for r in recs), 1e-9) / 1e6)
+
+    raw = sum(r["delta_bytes_raw"] + r["snap_bytes_raw"] for r in sock)
+    wire_b = sum(r["delta_bytes_wire"] + r["snap_bytes_wire"]
+                 for r in sock)
+    wstats = [r["wire_stats"] for r in sock]
+    out = {
+        "hosts": hosts,
+        "buckets": spec["buckets"],
+        "windows": spec["windows"],
+        "snapshots": spec["snapshots"],
+        "parity_tau0": True,
+        # raw (pre-codec) payload throughput of the delta allreduce leg
+        "socket_delta_mbps": mbps(sock, "delta_bytes_raw",
+                                  "delta_wall_s"),
+        "sim_delta_mbps": mbps(sim, "delta_bytes_raw", "delta_wall_s"),
+        "socket_snapshot_mbps": mbps(sock, "snap_bytes_raw",
+                                     "snap_wall_s"),
+        "sim_snapshot_mbps": mbps(sim, "snap_bytes_raw", "snap_wall_s"),
+        "bytes_raw": raw,
+        "bytes_wire": wire_b,
+        "wire_ratio": raw / max(wire_b, 1),
+        # encode/send overlap bought by the bounded outbox: the wall
+        # fraction NOT spent blocked inside collective spans
+        "overlap_frac": 1.0 - (
+            sum(r["collective_wait_s"] for r in sock)
+            / max(sum(r["wall_s"] for r in sock), 1e-9)),
+        "frames_sent": sum(w.get("frames_sent", 0) for w in wstats),
+        "coalesced_frames": sum(w.get("coalesced_frames", 0)
+                                for w in wstats),
+        "sends": sum(w.get("sends", 0) for w in wstats),
+        # kernel-level bytes the socket actually moved (headers incl.)
+        "bytes_socket_sent": sum(w.get("bytes_sent", 0)
+                                 for w in wstats),
+    }
+    out["socket_over_sim"] = (out["socket_delta_mbps"]
+                              / max(out["sim_delta_mbps"], 1e-9))
+    return out
+
+
 # ordered phase registry; headline phases first so a tight budget still
 # produces the metric. Phases needing the shared tile stores / the crec2
 # file / the text file are tagged so a filtered run only builds what it
@@ -2358,7 +2581,7 @@ PHASES = ["e2e_crec2", "device_tile", "e2e_stream", "e2e_text",
           "tile_online", "device_fm", "device_wide_deep",
           "channel_ratios", "tile_fused", "device_sparse",
           "device_dense_apply", "scale_curve", "bigmodel", "multichip",
-          "hierarchy",
+          "hierarchy", "socket_wire",
           "serve", "serve_fleet", "comm_filters", "async_ps", "kmeans",
           "lbfgs", "gbdt", "chaos", "rejoin"]
 _TEXT_PHASES = {"e2e_text", "tile_online"}
@@ -2490,6 +2713,10 @@ def _summarize(results: dict, failed: dict, skipped: list, pending: list,
         extra["hierarchy"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in results["hierarchy"].items()}
+    if "socket_wire" in results:
+        extra["socket_wire"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in results["socket_wire"].items()}
     if "e2e_stream" in results:
         stream = results["e2e_stream"]
         extra["e2e_stream_noncached"] = {
@@ -2534,6 +2761,14 @@ def _write_summary(path: str, summary: dict) -> None:
 def main(argv=None) -> None:
     import argparse
     import sys
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--socket-child":
+        # one rank of the socket_wire phase: handled before argparse
+        # (and before the jax import) so the re-exec'd children pay
+        # interpreter + numpy startup, not a full bench boot
+        _socket_wire_child(argv[1])
+        return
     import jax
     ap = argparse.ArgumentParser(
         description="wormhole-tpu benchmark; prints ONE summary JSON "
@@ -2617,6 +2852,7 @@ def main(argv=None) -> None:
         "bigmodel": bench_bigmodel,
         "multichip": bench_multichip,
         "hierarchy": bench_hierarchy,
+        "socket_wire": bench_socket_wire,
         "serve": bench_serve,
         "serve_fleet": bench_serve_fleet,
         "comm_filters": bench_comm_filters,

@@ -121,6 +121,11 @@ _DEBT_PAT = re.compile(r"recovery_debt_s$")
 # semantics — their payloads are synthetic fixtures, not the 2D sweep)
 _BYTES_WIRE_PAT = re.compile(r"bytes_wire$")
 _WIRE_RATIO_PAT = re.compile(r"wire_ratio$")
+# socket_wire-phase throughput keys (socket_delta_mbps, sim_delta_mbps,
+# *_snapshot_mbps), gated only under the socket_wire block: higher is
+# better, trend-gated pairwise like the ex/s rates so a socket OR sim
+# path that quietly slows down trips the --tol gate
+_MBPS_PAT = re.compile(r"_mbps$")
 # bigmodel-phase keys, gated only under the bigmodel block (bytes_h2d
 # also appears in raw feed stats with different semantics)
 _BM_BYTES_PAT = re.compile(r"bytes_h2d$")
@@ -182,6 +187,14 @@ _MAX_RECOVERY_DEBT = 60.0
 # swept dense bucket deltas; 2.0 passes that with headroom while
 # catching a chain that silently degrades to the raw codec (ratio -> 1)
 _MIN_WIRE_RATIO = 2.0
+# absolute floor on the newest BENCH run's socket_wire.socket_delta_mbps
+# (bench.py --phases socket_wire: 2-process loopback delta allreduce
+# through the full quant8+zlib chain over real TCP sockets). The
+# single-core CPU host measures ~55 MB/s raw-payload rate; 2.0 passes
+# that with a wide margin while catching a wire that degrades to
+# per-frame syscall lockstep or loses its encode/send overlap outright.
+# A multi-core host with a real NIC should be gated far higher.
+_MIN_SOCKET_MBPS = 2.0
 # absolute floor on the newest BENCH run's bigmodel.bigmodel_over_dense
 # (paged 16x-oversubscribed table vs the dense hot-size anchor, same
 # batch geometry). The single-core CPU host measures ~0.58 with zero
@@ -368,6 +381,17 @@ def compare(prev_name: str, prev: dict, cur_name: str, cur: dict,
                 f"{key}: {cv:.2f} < {pv:.2f} * {1 - tol:.2f} "
                 f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
                 "hierarchy wire compression regression")
+    psk, csk = (socket_keys(prev, _MBPS_PAT),
+                socket_keys(cur, _MBPS_PAT))
+    for key in sorted(set(psk) & set(csk)):
+        pv, cv = psk[key], csk[key]
+        if pv <= 0:
+            continue
+        if cv < pv * (1.0 - tol):
+            bad.append(
+                f"{key}: {cv:.1f} < {pv:.1f} * {1 - tol:.2f} "
+                f"({cv / pv:.2f}x, {cur_name} vs {prev_name}) — "
+                "socket/sim wire throughput regression")
     pbm, cbm = (bigmodel_keys(prev, _BM_RATIO_PAT),
                 bigmodel_keys(cur, _BM_RATIO_PAT))
     for key in sorted(set(pbm) & set(cbm)):
@@ -516,6 +540,45 @@ def hier_wire_gate(name: str, parsed: dict,
         "— hierarchy wire compression below the absolute floor"
         for key, v in sorted(hier_keys(parsed, _WIRE_RATIO_PAT).items())
         if v < min_ratio]
+    return bad
+
+
+def socket_keys(parsed: dict, pat: "re.Pattern") -> Dict[str, float]:
+    """``_keys_matching`` restricted to paths under a ``socket_wire``
+    block — the socket gates apply to the loopback measurement only
+    (the hierarchy block carries same-named wire leaves with SimBus
+    semantics)."""
+    return {p: v for p, v in _keys_matching(parsed, pat).items()
+            if ".socket_wire." in f".{p}."}
+
+
+def socket_wire_gate(name: str, parsed: dict,
+                     min_mbps: float) -> List[str]:
+    """Absolute gates on the newest run's socket_wire phase, both hard
+    meanings rather than trends: zero wire bytes means the loopback
+    processes exchanged nothing measurable (the phase's entire reason
+    to exist is real cross-process bytes), and a delta-allreduce rate
+    under the floor means the TCP path collapsed — lost overlap,
+    per-frame syscall lockstep, or a wedged outbox."""
+    bad = [
+        f"{key}: {v:.0f} <= 0 ({name}) — socket wire moved no "
+        "measured wire bytes"
+        for key, v in sorted(
+            socket_keys(parsed, _BYTES_WIRE_PAT).items())
+        if v <= 0]
+    blk = (parsed.get("extra") or {}).get("socket_wire")
+    if isinstance(blk, dict):
+        v = blk.get("socket_delta_mbps")
+        if isinstance(v, (int, float)) and v < min_mbps:
+            bad.append(
+                f"socket_wire.socket_delta_mbps: {v:.2f} < "
+                f"--min-socket-mbps {min_mbps:.2f} ({name}) — socket "
+                "delta-allreduce throughput below the absolute floor")
+        parity = blk.get("parity_tau0")
+        if parity is not None and parity is not True:
+            bad.append(
+                f"socket_wire.parity_tau0: {parity!r} ({name}) — "
+                "socket-vs-sim digests diverged at tau=0")
     return bad
 
 
@@ -684,7 +747,8 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
                      min_wire_ratio: float = _MIN_WIRE_RATIO,
                      min_bigmodel_ratio: float = _MIN_BIGMODEL_RATIO,
                      min_fleet_scaling: float = _MIN_FLEET_SCALING,
-                     min_snapshot_ratio: float = _MIN_SNAPSHOT_RATIO
+                     min_snapshot_ratio: float = _MIN_SNAPSHOT_RATIO,
+                     min_socket_mbps: float = _MIN_SOCKET_MBPS
                      ) -> Tuple[List[str], int, int]:
     """(failures, pairs_compared, keys_compared) for one run prefix."""
     runs = [(n, p) for n, p in load_runs(bench_dir, prefix)
@@ -701,6 +765,7 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
         failures.extend(bigmodel_gate(*runs[-1], min_bigmodel_ratio))
         failures.extend(fleet_gate(*runs[-1], min_fleet_scaling,
                                    min_snapshot_ratio))
+        failures.extend(socket_wire_gate(*runs[-1], min_socket_mbps))
         if slo:
             failures.extend(fleet_burn_gate(*runs[-1],
                                             max_burn=max_burn))
@@ -719,6 +784,8 @@ def _gate_trajectory(prefix: str, bench_dir: str, tol: float,
         compared += len(set(scaling_keys(pp)) & set(scaling_keys(cp)))
         compared += len(set(fleet_keys(pp, _QPS_SLO_PAT))
                         & set(fleet_keys(cp, _QPS_SLO_PAT)))
+        compared += len(set(socket_keys(pp, _MBPS_PAT))
+                        & set(socket_keys(cp, _MBPS_PAT)))
         failures.extend(compare(pn, pp, cn, cp, tol, tol_frac))
     return failures, len(pairs), compared
 
@@ -734,7 +801,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
         min_wire_ratio: float = _MIN_WIRE_RATIO,
         min_bigmodel_ratio: float = _MIN_BIGMODEL_RATIO,
         min_fleet_scaling: float = _MIN_FLEET_SCALING,
-        min_snapshot_ratio: float = _MIN_SNAPSHOT_RATIO) -> int:
+        min_snapshot_ratio: float = _MIN_SNAPSHOT_RATIO,
+        min_socket_mbps: float = _MIN_SOCKET_MBPS) -> int:
     failures: List[str] = []
     pairs = compared = 0
     for prefix in ("BENCH", "MULTICHIP"):
@@ -748,7 +816,8 @@ def run(bench_dir: str, tol: float, tol_frac: float,
                                    min_wire_ratio=min_wire_ratio,
                                    min_bigmodel_ratio=min_bigmodel_ratio,
                                    min_fleet_scaling=min_fleet_scaling,
-                                   min_snapshot_ratio=min_snapshot_ratio)
+                                   min_snapshot_ratio=min_snapshot_ratio,
+                                   min_socket_mbps=min_socket_mbps)
         failures.extend(f)
         pairs += p
         compared += c
@@ -831,6 +900,13 @@ def main(argv=None) -> int:
                          "serve_fleet snapshot.cadence_ratio (default "
                          f"{_MIN_SNAPSHOT_RATIO}; quant8 deltas on the "
                          "benched FTRL store measure ~15x)")
+    ap.add_argument("--min-socket-mbps", type=float,
+                    default=_MIN_SOCKET_MBPS,
+                    help="absolute floor on the newest BENCH run's "
+                         "socket_wire.socket_delta_mbps (default "
+                         f"{_MIN_SOCKET_MBPS}, CPU-calibrated: the "
+                         "single-core loopback host measures ~55 MB/s "
+                         "raw-payload rate; gate a real NIC far higher)")
     ap.add_argument("--all-pairs", action="store_true",
                     help="gate every consecutive pair in the "
                          "trajectory, not just the newest one")
@@ -858,7 +934,8 @@ def main(argv=None) -> int:
                min_wire_ratio=args.min_wire_ratio,
                min_bigmodel_ratio=args.min_bigmodel_ratio,
                min_fleet_scaling=args.min_fleet_scaling,
-               min_snapshot_ratio=args.min_snapshot_ratio)
+               min_snapshot_ratio=args.min_snapshot_ratio,
+               min_socket_mbps=args.min_socket_mbps)
 
 
 if __name__ == "__main__":

@@ -505,3 +505,53 @@ class Pager:
 
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))
+
+
+# -- WH-SOCKET ---------------------------------------------------------------
+
+from wormhole_tpu.analysis.checkers.sockets import SocketChecker  # noqa: E402
+
+
+def test_socket_import_outside_wire_module_flags(tmp_path):
+    """The launcher's old shape, verbatim: a module-level raw socket
+    import anywhere but the wire module is a second wire growing
+    outside the seam."""
+    diags = _run(tmp_path, SocketChecker, """\
+        import socket
+
+        def probe():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+        """, rel="parallel/launcher.py")
+    assert len(diags) == 1
+    assert diags[0].code == "WH-SOCKET"
+    assert diags[0].line == 1
+    assert "socket_wire.py" in diags[0].message
+
+
+def test_socket_from_import_flags(tmp_path):
+    diags = _run(tmp_path, SocketChecker,
+                 "from socket import create_connection\n",
+                 rel="serve/frontend.py")
+    assert len(diags) == 1
+    assert diags[0].code == "WH-SOCKET"
+
+
+def test_socket_wire_home_itself_exempt(tmp_path):
+    diags = _run(tmp_path, SocketChecker, "import socket\n",
+                 rel="parallel/socket_wire.py")
+    assert diags == []
+
+
+def test_socket_wire_surface_imports_not_flagged(tmp_path):
+    """Reaching sockets THROUGH the wire module's surface is the fix,
+    not a violation; socketserver-style names never match either."""
+    diags = _run(tmp_path, SocketChecker, """\
+        from wormhole_tpu.parallel.socket_wire import (SocketWire,
+                                                       free_port)
+        import socketserver
+
+        port = free_port()
+        """, rel="parallel/launcher.py")
+    assert diags == []

@@ -26,13 +26,13 @@ def _tree(tmp_path, files):
 
 # -- registry ----------------------------------------------------------------
 
-def test_registry_has_nine_checkers():
-    assert len(ALL_CHECKERS) == 9
+def test_registry_has_ten_checkers():
+    assert len(ALL_CHECKERS) == 10
     names = [c.name for c in ALL_CHECKERS]
     assert names == ["scatters", "knobs", "collectives", "spans",
                      "serve", "timeline", "donation", "threads",
-                     "hostsync"]
-    assert len({c.code for c in ALL_CHECKERS}) == 9
+                     "hostsync", "sockets"]
+    assert len({c.code for c in ALL_CHECKERS}) == 10
     for cls in ALL_CHECKERS:
         assert BY_NAME[cls.name] is cls
         assert cls.code.startswith("WH-")

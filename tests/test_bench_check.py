@@ -150,7 +150,7 @@ def test_real_trajectory_with_injected_drop_fails(tmp_path):
                 halve(v)
             elif isinstance(v, (int, float)) and not isinstance(v, bool) \
                     and k.endswith(("ex_per_sec", "examples_per_sec",
-                                    "rows_per_sec")):
+                                    "rows_per_sec", "_mbps")):
                 node[k] = v / 2
     halve(doc["parsed"])
     if isinstance(doc["parsed"].get("value"), (int, float)):
@@ -680,3 +680,72 @@ def test_tile_resolution_records_gated(tmp_path):
         del blk["tile_fused_vs_split"][k]
     _write_run(d, 1, _parsed(100_000.0, blk))
     assert _run("--dir", d).returncode == 0
+
+
+# -- socket_wire gates (bench.py --phases socket_wire) -----------------------
+
+def _socket(delta=54.7, sim=46.6, wire=3_212_602, parity=True):
+    return {"socket_wire": {"socket_delta_mbps": delta,
+                            "sim_delta_mbps": sim,
+                            "socket_snapshot_mbps": 120.0,
+                            "sim_snapshot_mbps": 110.0,
+                            "bytes_wire": wire,
+                            "parity_tau0": parity}}
+
+
+def test_socket_zero_wire_bytes_fails(tmp_path):
+    """The phase's reason to exist is real cross-process bytes: a zero
+    means the loopback children exchanged nothing measurable."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _socket(wire=0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "socket wire moved no measured wire bytes" in r.stderr
+
+
+def test_socket_mbps_floor_gates_newest_run(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _socket(delta=0.5)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "--min-socket-mbps" in r.stderr
+    # the flag relaxes the floor, same machinery as the other absolutes
+    r2 = _run("--dir", d, "--min-socket-mbps", "0.1")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_socket_parity_divergence_fails(tmp_path):
+    """tau=0 bit parity is the correctness witness: a socket-vs-sim
+    digest mismatch is a codec/framing bug, never a perf question."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _socket(parity=False)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "diverged at tau=0" in r.stderr
+
+
+def test_socket_mbps_trend_rides_tol(tmp_path):
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0, _socket(delta=54.7)))
+    _write_run(d, 2, _parsed(100_000.0, _socket(delta=20.0)))
+    r = _run("--dir", d)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "socket/sim wire throughput regression" in r.stderr
+    # within --tol the same pair passes
+    r2 = _run("--dir", d, "--tol", "0.7")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_mbps_keys_outside_socket_block_not_gated(tmp_path):
+    """Same-named *_mbps leaves under another phase block must not pick
+    up the socket floor or trend — the gates read the socket_wire
+    block only."""
+    d = str(tmp_path)
+    _write_run(d, 1, _parsed(100_000.0,
+                             {"warmup": {"socket_delta_mbps": 54.7,
+                                         "bytes_wire": 0}}))
+    _write_run(d, 2, _parsed(100_000.0,
+                             {"warmup": {"socket_delta_mbps": 0.5,
+                                         "bytes_wire": 0}}))
+    r = _run("--dir", d)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -704,3 +704,100 @@ def test_mp_trace_merge_without_jax_distributed(tmp_path):
     assert {0, 1} <= pids, pids
     assert "merged trace:" in r.stderr, r.stderr
     assert "collective skew: w1" in r.stderr, r.stderr
+
+
+def test_mp_socket_wire_trace_merge(tmp_path):
+    """The trace-merge drill with REAL cross-rank exchange and no
+    jax.distributed (runs in every environment): children peer over
+    the TCP wire (SocketWire loopback, built from the launcher's
+    PROCESS_ID/NUM_PROCESSES exports), run sited allreduces through
+    the full transport stack, and rank 1's planted lateness lands in
+    the launcher's exit-time skew report exactly as over the jax
+    wire — while the allreduce RESULT proves real cross-rank bytes,
+    which the single-process fast-path variant above cannot."""
+    import json
+    trace_dir = tmp_path / "traces"
+    hb_dir = tmp_path / "hb"
+    rdv = tmp_path / "rdv"
+    r = run_mp(2, f"""
+        import os, time
+        import numpy as np
+        from wormhole_tpu import obs
+        from wormhole_tpu.obs import trace
+        from wormhole_tpu.obs.metrics import Registry
+        from wormhole_tpu.parallel.socket_wire import SocketWire
+        from wormhole_tpu.parallel.transport import TransportStack
+        from wormhole_tpu.utils.config import Config
+        rank = int(os.environ["PROCESS_ID"])
+        hub = obs.setup(Config(), rank=rank, registry=Registry())
+        assert hub.active and trace.enabled(), "env fallbacks missing"
+        hub.heartbeat_tick(step=0, num_ex=0)
+        stack = TransportStack(wire=SocketWire(rendezvous={str(rdv)!r}))
+        for i in range(4):
+            if rank == 1:
+                time.sleep(0.1)            # the planted straggler
+            total = stack.allreduce(np.asarray(float(rank + 1)), None,
+                                    op="sum", site="test/step")
+            assert float(total) == 3.0, total   # real 2-rank sum
+        stack.sync("done")
+        hub.finalize(step=4, num_ex=400, wall_s=1.0)
+        stack.wire.close()
+        print(f"OK rank {{rank}}")
+    """, launcher_args=("--heartbeat-dir", str(hb_dir),
+                        "--trace-dir", str(trace_dir)), raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("OK rank") == 2
+
+    assert (trace_dir / "merged.trace.json").exists()
+    report = json.load(open(trace_dir / "skew_report.json"))
+    assert report["ranks"] == [0, 1]
+    assert report["clock_source"] == "heartbeat"
+    assert report["collectives_matched"] == 4
+    w = report["worst"]
+    assert w["rank"] == 1, report
+    # cumulative sleeps: rank 1 trails by ~100*k ms at the k-th
+    # collective (arrival skew survives the socket hop unchanged)
+    assert w["lateness_ms"] > 300, report
+    assert report["sites"]["test/step"]["max_skew_ms"] > 100, report
+    assert "collective skew: w1" in r.stderr, r.stderr
+
+
+def test_mp_socket_wire_supervised_drill(tmp_path):
+    """Supervised PEER_LOST drill over the TCP wire: rank 1 dies
+    mid-program on the first attempt, rank 0's wire DETECTS the
+    disconnect (no timeout wait) and takes the watchdog's PEER_LOST
+    exit, the launcher's --restarts relaunches the world, and the
+    retry completes over a fresh per-attempt mesh."""
+    marker = tmp_path / "crashed_once"
+    rdv = tmp_path / "rdv"
+    body = f"""
+        import os
+        import numpy as np
+        from wormhole_tpu.ft import watchdog
+        from wormhole_tpu.parallel.socket_wire import SocketWire
+        from wormhole_tpu.parallel.transport import TransportStack
+        rank = int(os.environ["PROCESS_ID"])
+        watchdog.configure(60.0)
+        # per-attempt rendezvous dir: the retry must not dial attempt
+        # 1's dead ports out of a stale committed peer table
+        rdv = os.path.join({str(rdv)!r}, os.environ["WORMHOLE_ATTEMPT"])
+        stack = TransportStack(wire=SocketWire(rendezvous=rdv))
+        stack.sync("mesh_up")
+        if rank == 1 and not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            os._exit(17)                   # die mid-program
+        total = stack.allreduce(np.asarray(float(rank + 1)), None,
+                                op="sum", site="drill/step")
+        assert float(total) == 3.0, total
+        stack.wire.close()
+        print(f"OK rank {{rank}}")
+    """
+    r = run_mp(2, body, timeout=240, launcher_args=("--restarts", "2"),
+               raw=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert marker.exists(), "crash never fired"
+    # rank 0 did not wait out a timeout: the wire detected the loss
+    # and surfaced it through the watchdog taxonomy
+    assert "peer rank 1 lost" in r.stderr, r.stderr
+    assert "restart 1/2" in r.stderr, r.stderr
+    assert r.stdout.count("OK rank") == 2

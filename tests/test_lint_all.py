@@ -1,4 +1,4 @@
-"""The unified runner (scripts/lint.py): the whole nine-checker suite
+"""The unified runner (scripts/lint.py): the whole ten-checker suite
 is green on this repo, the CLI surface works, and running everything
 in one process stays cheaper than two invocations of the slowest
 legacy shim."""
@@ -26,10 +26,11 @@ def _run(*args, script=SCRIPT):
 def test_repo_is_clean():
     r = _run("--root", REPO)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "lint: OK (9 checkers" in r.stdout
+    assert "lint: OK (10 checkers" in r.stdout
     # every checker prints its own success line
     for name in ("scatters", "knobs", "collectives", "spans", "serve",
-                 "timeline", "donation", "threads", "hostsync"):
+                 "timeline", "donation", "threads", "hostsync",
+                 "sockets"):
         assert f"{name}:" in r.stdout
 
 
@@ -37,7 +38,7 @@ def test_list_catalog():
     r = _run("--list")
     assert r.returncode == 0
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
-    assert len(lines) == 9
+    assert len(lines) == 10
     assert any(ln.startswith("donation") and "WH-DONATE" in ln
                for ln in lines)
     assert any("WH-SCATTER" in ln for ln in lines)
@@ -71,7 +72,7 @@ def test_json_output():
     assert payload["files"] > 20
     assert 0 < payload["parses"] <= payload["files"]
     checkers = {c["name"]: c for c in payload["checkers"]}
-    assert len(checkers) == 9
+    assert len(checkers) == 10
     assert all(c["ok"] and c["findings"] == []
                for c in checkers.values()), checkers
     assert checkers["donation"]["code"] == "WH-DONATE"
@@ -123,7 +124,7 @@ def _best_of(fn, repeats=3):
 
 @pytest.mark.slow
 def test_unified_suite_beats_legacy_budget():
-    """Acceptance bound: the full nine-checker suite costs under 2x
+    """Acceptance bound: the full ten-checker suite costs under 2x
     the slowest legacy lint, proving the shared-parse win.
 
     The seed-era scripts/lint_*.py each walked wormhole_tpu/ and

@@ -61,6 +61,21 @@ def test_clock_offsets_median_robust():
     assert merge.clock_offsets({1: [{"rank": 1}]}) == {}   # no stamps
 
 
+def test_clock_offsets_single_sample_excluded():
+    """A rank whose heartbeat file holds exactly ONE two-stamp record
+    (it died mid-window) gets no offset — a 1-sample 'median' is the
+    unrobust estimate the median exists to avoid — and falls back to
+    its wall_t0 anchor in _unified_base, while ranks with >= 2 samples
+    still ride the heartbeat clock."""
+    hb = {0: _hb(0, 50.0, 0.0), 1: _hb(1, 80.0, 3.0, n=1)}
+    offs = merge.clock_offsets(hb)
+    assert 1 not in offs
+    assert offs[0] == pytest.approx(1000.0)
+    # callers that want the permissive old behaviour ask for it
+    offs1 = merge.clock_offsets(hb, min_samples=1)
+    assert offs1[1] == pytest.approx(1003.0)
+
+
 def test_merge_matches_collectives_and_names_straggler():
     # rank 1 arrives 5 ms late at every collective. Its recorder started
     # 7 s after rank 0's on the shared monotonic clock (mono_t0 107 vs

@@ -1,4 +1,4 @@
-"""Checker registry: the nine analyses the unified runner executes.
+"""Checker registry: the ten analyses the unified runner executes.
 
 Order matters only for output stability; every checker consumes the
 same one-pass :class:`~wormhole_tpu.analysis.engine.FileContext`
@@ -16,6 +16,7 @@ from wormhole_tpu.analysis.checkers.timeline import TimelineChecker
 from wormhole_tpu.analysis.checkers.donation import DonationChecker
 from wormhole_tpu.analysis.checkers.threads import ThreadChecker
 from wormhole_tpu.analysis.checkers.hostsync import HostSyncChecker
+from wormhole_tpu.analysis.checkers.sockets import SocketChecker
 
 ALL_CHECKERS = (
     ScatterChecker,
@@ -27,6 +28,7 @@ ALL_CHECKERS = (
     DonationChecker,
     ThreadChecker,
     HostSyncChecker,
+    SocketChecker,
 )
 
 BY_NAME = {cls.name: cls for cls in ALL_CHECKERS}

@@ -1,0 +1,106 @@
+"""WH-SOCKET: raw socket use lives only in the wire module.
+
+The cross-host TCP leg (frames, rendezvous, mesh lifecycle, PEER_LOST
+surfacing) is owned by ``wormhole_tpu/parallel/socket_wire.py``. A raw
+``socket`` import anywhere else in the package is a second wire growing
+outside the seam — bytes that skip the FilterChain accounting, the
+watchdog guard, and the sim-vs-socket parity oracle. Anything needing a
+port or a connection goes through the wire module's surface
+(``free_port``, ``SocketWire``, ``Rendezvous``) instead.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from wormhole_tpu.analysis.engine import Checker, Engine, FileContext
+
+# The single file allowed to import the socket module.
+WIRE_HOME = "wormhole_tpu/parallel/socket_wire.py"
+
+# Audited files outside WIRE_HOME that legitimately import socket.
+# Deliberately EMPTY: the socket-wire PR moved the launcher's port
+# probe into the wire module, and new entries should be rare and argued.
+ALLOWLIST: dict = {}
+
+# both spellings of a module-level import; \b keeps socketserver-style
+# names (and the wire's own socket_wire imports) out of the match
+_PAT = re.compile(r"^\s*(?:import\s+socket\b(?!\s*_)"
+                  r"|from\s+socket\b(?!\s*_)\s+import\b)",
+                  re.MULTILINE)
+
+# fast whole-file gate: no "socket" substring, no finding possible
+_PRE = re.compile(r"socket")
+
+
+def _scan_code(code: str) -> list:
+    return [code.count("\n", 0, m.start()) + 1
+            for m in _PAT.finditer(code)]
+
+
+def scan_file(path: str) -> list:
+    """Return 1-based line numbers of raw ``socket`` imports."""
+    from wormhole_tpu.analysis.engine import strip_comments
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return _scan_code(strip_comments(f.read()))
+
+
+class SocketChecker(Checker):
+    name = "sockets"
+    code = "WH-SOCKET"
+
+    def __init__(self, root: str) -> None:
+        super().__init__(root)
+        self.violations: list = []   # "rel:line"
+        self.seen_allowed: set = set()
+
+    def visit(self, ctx: FileContext) -> None:
+        if ctx.rel == WIRE_HOME:
+            return  # the one file that owns the sockets
+        if _PRE.search(ctx.raw) is None:
+            return
+        lines = _scan_code(ctx.code)
+        if not lines:
+            return
+        if ctx.rel in ALLOWLIST:
+            self.seen_allowed.add(ctx.rel)
+            return
+        for ln in lines:
+            self.violations.append(f"{ctx.rel}:{ln}")
+            self.report(ctx.rel, ln,
+                        f"raw socket import outside {WIRE_HOME} — use "
+                        f"the wire module's surface (free_port / "
+                        f"SocketWire / Rendezvous)")
+
+    def finish(self) -> None:
+        for rel in sorted(set(ALLOWLIST) - self.seen_allowed):
+            self.warnings.append(
+                f"lint_sockets: allowlist entry {rel} has no raw "
+                f"socket imports (stale?)")
+
+    def ok_line(self) -> str:
+        return (f"{self.name}: OK ({len(self.seen_allowed)} "
+                f"allowlisted files)")
+
+
+def run(root: str) -> int:
+    """Scan ``root``/wormhole_tpu for violations; return a process rc."""
+    pkg = os.path.join(root, "wormhole_tpu")
+    if not os.path.isdir(pkg):
+        print(f"lint_sockets: no wormhole_tpu package under {root!r}",
+              file=sys.stderr)
+        return 2
+    chk = SocketChecker(root)
+    Engine(root, [chk]).run()
+    for w in chk.warnings:
+        print(w, file=sys.stderr)
+    if chk.violations:
+        print(f"lint_sockets: raw socket imports outside {WIRE_HOME}:",
+              file=sys.stderr)
+        for v in chk.violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(chk.ok_line())
+    return 0

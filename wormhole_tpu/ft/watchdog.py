@@ -96,6 +96,15 @@ class CollectiveWatchdog:
         finally:
             self.disarm()
 
+    def trip(self, site: str) -> None:
+        """Fire the exit path immediately, without waiting out the
+        timeout. For callers that positively *detect* peer loss (the
+        socket wire sees the connection drop) rather than infer it from
+        silence — the taxonomy (flight record + PEER_LOST exit, or the
+        injected test recorder) stays identical either way."""
+        self.fired_site = str(site)
+        self._exit(str(site))
+
     def stop(self) -> None:
         """Shut the monitor thread down (tests; production exits instead)."""
         with self._cv:

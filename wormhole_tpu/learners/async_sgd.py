@@ -147,7 +147,13 @@ class AsyncSGD:
         # process-global so every collective below — metric windows,
         # pooled AUC, model broadcast — rides the same chain
         from wormhole_tpu.parallel import filters as comm_filters
+        from wormhole_tpu.parallel import transport as comm_transport
         comm_filters.install_from_config(cfg)
+        # cross-host wire selection (parallel/socket_wire.py): wire=
+        # socket swaps the default stack's host leg onto the TCP wire
+        # before anything caches a stack reference; intra-host ICI
+        # collectives are untouched
+        comm_transport.install_wire_from_config(cfg)
         # fault-tolerance wiring (wormhole_tpu/ft): the collective
         # watchdog turns a hang on a dead peer into a PEER_LOST exit,
         # chaos installs the deterministic fault plan, and the drain

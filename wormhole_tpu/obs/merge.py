@@ -90,14 +90,23 @@ def load_rank_traces(trace_dir: str) -> Dict[int, dict]:
     return out
 
 
-def clock_offsets(by_rank: Dict[int, List[dict]]) -> Dict[int, float]:
+def clock_offsets(by_rank: Dict[int, List[dict]],
+                  min_samples: int = 2) -> Dict[int, float]:
     """Per-rank wall-minus-monotonic offset, the median over heartbeat
-    records carrying both stamps (robust to one torn/laggy sample)."""
+    records carrying both stamps (robust to one torn/laggy sample).
+
+    A rank needs at least ``min_samples`` two-stamp records to get an
+    offset at all: a heartbeat file that appeared mid-window (late
+    start, supervised respawn) holds one sample, and a "median" of one
+    — possibly taken during startup stall — is exactly the unrobust
+    estimate the median exists to avoid. Ranks left out here fall back
+    to their recorded ``wall_t0`` in :func:`_unified_base`, same as
+    ranks with no offset model at all."""
     out: Dict[int, float] = {}
     for rank, recs in by_rank.items():
         diffs = sorted(float(r["ts"]) - float(r["mono"])
                        for r in recs if "ts" in r and "mono" in r)
-        if diffs:
+        if len(diffs) >= max(1, min_samples):
             out[rank] = diffs[len(diffs) // 2]
     return out
 

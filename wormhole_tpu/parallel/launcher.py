@@ -28,16 +28,13 @@ from __future__ import annotations
 
 import argparse
 import os
-import socket
 import subprocess
 import sys
 from typing import List
 
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+# raw socket use lives in the wire module (checker WH-SOCKET); the
+# launcher only needs its port probe
+from wormhole_tpu.parallel.socket_wire import free_port as _free_port
 
 
 def _base_env() -> dict:

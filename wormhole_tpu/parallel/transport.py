@@ -47,6 +47,7 @@ On top of the stack sit the two composite transports:
 
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 from dataclasses import dataclass
@@ -65,6 +66,7 @@ __all__ = [
     "ChaosLayer", "WatchdogLayer", "FilterLayer", "AccountingLayer",
     "Wire", "ProcessWire", "SimBus", "BusWire",
     "TransportStack", "default_stack", "set_default_stack",
+    "install_wire_from_config",
     "default_layers", "validate_layers", "reset_site_seq",
     "MeshTransport", "HierarchicalTransport", "ici_ring_bytes",
 ]
@@ -615,6 +617,45 @@ def set_default_stack(stack: Optional[TransportStack]):
     global _DEFAULT
     prev, _DEFAULT = _DEFAULT, stack
     return prev
+
+
+def install_wire_from_config(cfg) -> Optional[TransportStack]:
+    """Route the cross-host leg per the ``wire`` knob.
+
+    Only the HOST wire is selected here — every consumer of the default
+    stack (``hier/delta`` deltas, snapshot fan-out, checkpoint fences,
+    rejoin ctl) picks the change up through ``default_stack()``, and the
+    intra-host ICI leg is untouched either way:
+
+    - ``"process"``: the existing jax.distributed wire; nothing to do
+      (the lazy default builds ProcessWire/LocalWire itself).
+    - ``"socket"``: the repo-owned TCP wire (parallel/socket_wire.py),
+      discovered through ``cfg.wire_rendezvous`` (or the env fallback).
+    - ``"sim"``: the in-process SimBus oracle. Only coherent inside one
+      process — a multi-process run selecting it would silently stop
+      exchanging, so world > 1 is an error.
+    """
+    from wormhole_tpu.utils.config import check_choice
+    choice = check_choice("wire", cfg.wire, ("process", "socket", "sim"))
+    if choice == "process":
+        return None
+    if choice == "sim":
+        world = int(os.environ.get("NUM_PROCESSES", "1"))
+        if world > 1:
+            raise ValueError(
+                "wire=sim is the single-process deterministic oracle; "
+                f"this run has NUM_PROCESSES={world} — use wire=socket "
+                "(or wire=process) for real multi-process exchange")
+        bus = SimBus(1)
+        stack = TransportStack(wire=BusWire(bus, 0))
+    else:
+        from wormhole_tpu.parallel.socket_wire import SocketWire
+        stack = TransportStack(wire=SocketWire(
+            rendezvous=cfg.wire_rendezvous or None,
+            outbox_depth=cfg.wire_outbox_depth,
+            timeout_s=cfg.comm_timeout_s or 120.0))
+    set_default_stack(stack)
+    return stack
 
 
 # ---------------------------------------------------------------------------

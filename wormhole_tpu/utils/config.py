@@ -191,6 +191,21 @@ class Config:
     # mesh_shape (e.g. "data:2,model:2"); empty = each host puts all its
     # local devices on "data". Ignored unless hier_hosts > 0.
     hier_mesh_shape: str = ""
+    # --- cross-host wire (parallel/socket_wire.py) ---
+    # which transport carries the cross-host leg (hier/delta, fleet
+    # snapshot fan-out, rejoin ctl): "process" = jax.distributed
+    # collectives (the default; intra-host stays on ICI either way),
+    # "socket" = the repo-owned TCP wire (real multi-process bytes,
+    # needs wire_rendezvous), "sim" = in-process SimBus threads (the
+    # deterministic oracle; world size 1 only).
+    wire: str = "process"
+    # shared rendezvous directory for wire=socket peer discovery (rank
+    # adverts + rank-0 peer table, committed tmp+fsync+replace); falls
+    # back to the WORMHOLE_WIRE_RENDEZVOUS env var when empty.
+    wire_rendezvous: str = ""
+    # per-peer bounded outbox depth, in frames: how far FilterChain
+    # encode may run ahead of socket I/O before the sender backpressures
+    wire_outbox_depth: int = 8
 
     # --- L-BFGS specifics (reference learn/solver/lbfgs.h SetParam surface) ---
     max_lbfgs_iter: int = 100
